@@ -1,0 +1,96 @@
+"""Headline benchmark: DDP MNIST samples/sec/chip (BASELINE.json metric).
+
+Runs the framework's DDP MNIST training step (ConvNet, dropout on, SGD —
+the reference's stock hot loop, SURVEY.md §3.3) on all visible devices and
+prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": R}
+
+vs_baseline compares against the measured reference config #1 (stock torch
+DDP MNIST, 2-rank gloo CPU — benchmarks/baseline_measured.json; re-measure
+with benchmarks/torch_reference_mnist.py). Matching geometry: batch 64 per
+chip, same synthetic data generator, dropout active.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import pytorch_distributed_example_tpu as tdx
+    from pytorch_distributed_example_tpu.models import ConvNet
+
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", "64"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "20"))
+    steps = int(os.environ.get("BENCH_STEPS", "200"))
+
+    tdx.init_process_group(backend="xla")
+    world = tdx.get_world_size()
+    global_batch = batch_per_chip * world
+
+    model = ConvNet()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)))
+    ddp = tdx.DistributedDataParallel(model, params)
+    opt = optax.sgd(0.01, momentum=0.5)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    step = ddp.make_train_step(opt, loss_fn, has_rng=True)
+    opt_state = opt.init(ddp.params)
+
+    gen = np.random.default_rng(0)
+    x = gen.standard_normal((global_batch, 28, 28, 1)).astype(np.float32)
+    y = gen.integers(0, 10, global_batch).astype(np.int32)
+
+    p = ddp.params
+    key = rng
+    for _ in range(warmup):
+        key, sub = jax.random.split(key)
+        p, opt_state, loss = step(p, opt_state, x, y, sub)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        p, opt_state, loss = step(p, opt_state, x, y, sub)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    per_chip = steps * global_batch / dt / world
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "baseline_measured.json",
+    )
+    vs = 0.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        ref = base.get("samples_per_sec_per_chip") or 0
+        if ref:
+            vs = per_chip / ref
+
+    print(
+        json.dumps(
+            {
+                "metric": "ddp_mnist_samples_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

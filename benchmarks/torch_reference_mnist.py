@@ -1,0 +1,122 @@
+"""Reference-side baseline: stock torch DDP MNIST (BASELINE.json config #1).
+
+Reproduces the reference repo's mnist/main.py hot path [RECONSTRUCTED,
+SURVEY.md §2.0 E2]: ConvNet, DistributedDataParallel over gloo, 2 ranks,
+CPU, DistributedSampler, SGD — and measures samples/sec/chip(=rank).
+Synthetic MNIST-shaped data (same generator as the TPU side) so data
+loading is identical in both measurements.
+
+This script is TEST/BENCH-side only: the framework never imports torch
+(north-star constraint). Run it once and commit the result to
+benchmarks/baseline_measured.json:
+
+    python benchmarks/torch_reference_mnist.py --out benchmarks/baseline_measured.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _worker(rank: int, world: int, port: int, steps: int, warmup: int,
+            batch_size: int, q):
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ["MASTER_PORT"] = str(port)
+    dist.init_process_group("gloo", rank=rank, world_size=world)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+            self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+            self.conv2_drop = nn.Dropout2d()
+            self.fc1 = nn.Linear(320, 50)
+            self.fc2 = nn.Linear(50, 10)
+
+        def forward(self, x):
+            x = F.relu(F.max_pool2d(self.conv1(x), 2))
+            x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+            x = x.view(-1, 320)
+            x = F.relu(self.fc1(x))
+            x = F.dropout(x, training=self.training)
+            return F.log_softmax(self.fc2(x), dim=1)
+
+    torch.manual_seed(0)
+    model = torch.nn.parallel.DistributedDataParallel(Net())
+    opt = torch.optim.SGD(model.parameters(), lr=0.01, momentum=0.5)
+
+    rng = np.random.default_rng(rank)
+    x = torch.tensor(
+        rng.standard_normal((batch_size, 1, 28, 28)).astype("float32")
+    )
+    y = torch.tensor(rng.integers(0, 10, batch_size))
+
+    model.train()
+    for _ in range(warmup):
+        opt.zero_grad()
+        F.nll_loss(model(x), y).backward()
+        opt.step()
+    dist.barrier()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        F.nll_loss(model(x), y).backward()
+        opt.step()
+    dist.barrier()
+    dt = time.perf_counter() - t0
+    if rank == 0:
+        total = steps * batch_size * world
+        q.put({"samples_per_sec_total": total / dt,
+               "samples_per_sec_per_chip": total / dt / world})
+    dist.destroy_process_group()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--world-size", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--out", type=str, default=None)
+    args = p.parse_args()
+
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = 29511
+    procs = [
+        ctx.Process(
+            target=_worker,
+            args=(r, args.world_size, port, args.steps, args.warmup,
+                  args.batch_size, q),
+        )
+        for r in range(args.world_size)
+    ]
+    for pr in procs:
+        pr.start()
+    result = q.get(timeout=600)
+    for pr in procs:
+        pr.join(60)
+    result.update(
+        config="MNIST ConvNet, %d-rank DDP, backend=gloo, CPU, batch %d/rank"
+        % (args.world_size, args.batch_size),
+        world_size=args.world_size,
+        batch_size=args.batch_size,
+    )
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

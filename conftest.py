@@ -1,0 +1,25 @@
+"""Repo-root conftest: force tests onto a virtual 8-device CPU mesh.
+
+The reference's test strategy (SURVEY.md §4) runs multi-rank semantics tests
+without a cluster (torch MultiThreadedTestCase / MultiProcessTestCase,
+torch/testing/_internal/common_distributed.py:874,1443). The JAX analog is a
+host-platform device-count override: 8 virtual CPU devices in one process.
+
+This environment's sitecustomize pre-registers the TPU (axon) PJRT plugin at
+interpreter start and pins `jax_platforms`, so the env-var route alone is
+not enough — we must also update jax.config before any backend initializes.
+
+Benchmarks (bench.py) do NOT go through pytest and still see the real TPU.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

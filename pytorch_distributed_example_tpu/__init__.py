@@ -1,0 +1,67 @@
+"""pytorch_distributed_example_tpu — a TPU-native distributed training framework.
+
+Built from scratch on JAX/XLA: collectives lower to ICI collectives
+(`psum` / `all_gather` / `ppermute` / `all_to_all`) over a
+`jax.sharding.Mesh` instead of Gloo/NCCL rings, the DDP-equivalent gradient
+path is a `shard_map`-compiled `pmean` inside the jitted train step (with a
+bucketed eager Reducer for the interop path), and data sharding matches
+`torch.utils.data.DistributedSampler` semantics.
+
+Capability parity target: dblakely/pytorch-distributed-example and the torch
+machinery it exercises — see SURVEY.md §2 for the component inventory this
+package answers item by item.
+
+Typical alias:
+
+    import pytorch_distributed_example_tpu as tdx
+
+    tdx.init_process_group(backend="xla", world_size=8)
+    t = tdx.DistTensor.from_rank_fn(lambda r: jnp.array([float(r)]))
+    tdx.all_reduce(t)          # every rank now holds sum(0..7)
+"""
+
+from .types import (  # noqa: F401
+    OpType,
+    ReduceOp,
+    Work,
+)
+from .mesh import DeviceMesh, init_device_mesh  # noqa: F401
+from .distributed import (  # noqa: F401
+    Backend,
+    DistTensor,
+    GroupMember,
+    ProcessGroup,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    gather,
+    get_backend,
+    get_rank,
+    get_world_size,
+    init_process_group,
+    is_initialized,
+    new_group,
+    new_subgroups,
+    scatter_object_list,
+    get_process_group_ranks,
+    default_pg_timeout,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    batch_isend_irecv,
+    P2POp,
+    irecv,
+    isend,
+    all_gather_object,
+    broadcast_object_list,
+    monitored_barrier,
+)
+from .data.sampler import DistributedSampler  # noqa: F401
+from .parallel.ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,79 @@
+"""Backend registry.
+
+Parity surface: torch c10d `Backend` registry + third-party plugin seam
+`Backend.register_backend(name, creator_fn, devices)` — torch
+`distributed_c10d.py:270,341-407` and unknown-backend dispatch `:2240-2262`
+(SURVEY.md §5.8). This is the exact seam BASELINE.json's north star names
+for the `xla` backend; here `xla` is the *default*, not the plugin.
+
+Device→backend defaults mirror torch's `Backend.default_device_backend_map`
+(`distributed_c10d.py:304-309`): `{"cpu": gloo, "cuda": nccl, ...}` becomes
+`{"tpu": "xla", "cpu": "xla"}` — the XLA backend drives both real ICI
+meshes and virtual host-platform meshes with the same compiled programs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .base import Backend, BackendError
+from .fake import FakeBackend
+from .xla import XlaBackend
+
+_registry: Dict[str, Callable] = {}
+
+default_device_backend_map: Dict[str, str] = {
+    "tpu": "xla",
+    "cpu": "xla",
+}
+
+UNDEFINED = "undefined"
+XLA = "xla"
+FAKE = "fake"
+
+
+def register_backend(name: str, creator: Callable, *, devices=None, overwrite: bool = False) -> None:
+    """Register a third-party backend (torch `distributed_c10d.py:341-407`).
+
+    `creator(mesh, rank, world_size, timeout) -> Backend`.
+    """
+    name = name.lower()
+    if name in _registry and not overwrite:
+        raise ValueError(f"backend {name!r} already registered")
+    _registry[name] = creator
+    if devices:
+        for d in devices if isinstance(devices, (list, tuple)) else [devices]:
+            default_device_backend_map[d] = name
+
+
+def backend_registered(name: str) -> bool:
+    return name.lower() in _registry
+
+
+def create_backend(name: str, mesh, rank: int, world_size: int, timeout: float) -> Backend:
+    name = (name or XLA).lower()
+    creator = _registry.get(name)
+    if creator is None:
+        raise BackendError(
+            f"unknown backend {name!r}; registered: {sorted(_registry)}"
+        )
+    return creator(mesh, rank, world_size, timeout)
+
+
+register_backend(XLA, XlaBackend)
+register_backend(FAKE, FakeBackend)
+# historical-name aliases: the reference launches with --backend gloo/nccl;
+# on TPU both resolve to the XLA ICI backend so stock scripts run unchanged.
+register_backend("gloo", XlaBackend)
+register_backend("nccl", XlaBackend)
+
+__all__ = [
+    "Backend",
+    "BackendError",
+    "FakeBackend",
+    "XlaBackend",
+    "register_backend",
+    "backend_registered",
+    "create_backend",
+    "default_device_backend_map",
+]

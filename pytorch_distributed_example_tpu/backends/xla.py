@@ -1,0 +1,264 @@
+"""XlaBackend — eager collectives compiled to XLA ICI collectives.
+
+This is the TPU-native replacement for torch's ProcessGroupGloo/NCCL
+(SURVEY.md §2.2 N8/N10, §5.8): instead of a worker-thread pool running ring
+algorithms over TCP (`ProcessGroupGloo.hpp:48-498`) or NCCL kernels, each
+collective is a tiny `shard_map` program over the group's 1-D device mesh,
+jit-compiled once per (op, shape, dtype) and cached (SURVEY.md §7 hard part
+1: persistent compiled collective executables keyed by shape/dtype/op).
+XLA lowers them to the native ICI collective implementations (psum /
+all-gather / all-to-all / collective-permute), which is what the gloo/nccl
+ring code hand-implements on CPU/GPU.
+
+Dispatch is async (XLA enqueues and returns), so the returned `ArrayWork`
+plays the role of gloo's `AsyncWork` (`ProcessGroupGloo.hpp:66`) with
+`wait()` = block-until-ready — no comm threads needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+from ..mesh import DeviceMesh
+from ..types import ArrayWork, OpType, ReduceOp, Work, _PremulSum
+from .base import Backend
+
+AXIS = "_ranks"
+
+
+def _shard_map():
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm  # type: ignore
+
+    return sm
+
+
+def _fold_op(op: ReduceOp):
+    """Local fold used for ops with no dedicated ICI primitive."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    return {
+        ReduceOp.PRODUCT: lambda g: jnp.prod(g, axis=0, keepdims=True),
+        ReduceOp.BAND: lambda g: lax.reduce(
+            g, _ones_like_init(g), lax.bitwise_and, (0,)
+        )[None],
+        ReduceOp.BOR: lambda g: lax.reduce(
+            g, _zeros_like_init(g), lax.bitwise_or, (0,)
+        )[None],
+        ReduceOp.BXOR: lambda g: lax.reduce(
+            g, _zeros_like_init(g), lax.bitwise_xor, (0,)
+        )[None],
+    }[op]
+
+
+def _ones_like_init(g):
+    import jax.numpy as jnp
+
+    return jnp.array(-1, dtype=g.dtype) if g.dtype != jnp.bool_ else jnp.array(True)
+
+
+def _zeros_like_init(g):
+    import jax.numpy as jnp
+
+    return jnp.array(0, dtype=g.dtype) if g.dtype != jnp.bool_ else jnp.array(False)
+
+
+class XlaBackend(Backend):
+    """Collectives over the ICI/host mesh via cached shard_map programs."""
+
+    name = "xla"
+
+    def __init__(self, mesh: DeviceMesh, rank: int, world_size: int, timeout: float = 1800.0):
+        super().__init__(mesh.flattened(AXIS), rank, world_size, timeout)
+        self._progs: dict = {}
+
+    # -- program construction ---------------------------------------------
+    def _build(self, key, local_fn):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        prog = self._progs.get(key)
+        if prog is None:
+            sm = _shard_map()
+            mapped = sm(
+                local_fn,
+                mesh=self.mesh.jax_mesh,
+                in_specs=P(AXIS),
+                out_specs=P(AXIS),
+                check_vma=False,
+            )
+            prog = jax.jit(mapped)
+            self._progs[key] = prog
+        return prog
+
+    def _reduce_local(self, op):
+        """Returns f(x_local) -> reduced (1, *s) block, given op."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if isinstance(op, _PremulSum):
+            factor = op.factor
+            return lambda x: lax.psum(x * jnp.asarray(factor, x.dtype), AXIS)
+        if op == ReduceOp.SUM:
+            return lambda x: lax.psum(x, AXIS)
+        if op == ReduceOp.AVG:
+            return lambda x: lax.pmean(x, AXIS)
+        if op == ReduceOp.MAX:
+            return lambda x: lax.pmax(x, AXIS)
+        if op == ReduceOp.MIN:
+            return lambda x: lax.pmin(x, AXIS)
+        # gather + local fold for PRODUCT / bitwise ops
+        fold = _fold_op(op)
+
+        def f(x):
+            g = lax.all_gather(x[0], AXIS, axis=0, tiled=False)  # (W, *s)
+            return fold(g)
+
+        return f
+
+    # -- collectives -------------------------------------------------------
+    def allreduce(self, x, op: Any = ReduceOp.SUM) -> Tuple[Any, Work]:
+        red = self._reduce_local(op)
+        prog = self._build(("allreduce", op), lambda t: red(t))
+        out = prog(x)
+        return out, ArrayWork(out, OpType.ALLREDUCE, "xla:all_reduce")
+
+    def broadcast(self, x, src: int) -> Tuple[Any, Work]:
+        from jax import lax
+
+        def f(t):
+            g = lax.all_gather(t[0], AXIS, axis=0, tiled=False)  # (W, *s)
+            return g[src : src + 1]
+
+        out = self._build(("broadcast", src), f)(x)
+        return out, ArrayWork(out, OpType.BROADCAST, "xla:broadcast")
+
+    def reduce(self, x, dst: int, op: Any = ReduceOp.SUM) -> Tuple[Any, Work]:
+        import jax.numpy as jnp
+        from jax import lax
+
+        red = self._reduce_local(op)
+
+        def f(t):
+            r = red(t)
+            i = lax.axis_index(AXIS)
+            return jnp.where(i == dst, r, t)
+
+        out = self._build(("reduce", dst, op), f)(x)
+        return out, ArrayWork(out, OpType.REDUCE, "xla:reduce")
+
+    def allgather(self, x) -> Tuple[Any, Work]:
+        from jax import lax
+
+        def f(t):
+            return lax.all_gather(t[0], AXIS, axis=0, tiled=False)[None]  # (1, W, *s)
+
+        out = self._build(("allgather",), f)(x)
+        return out, ArrayWork(out, OpType.ALLGATHER, "xla:all_gather")
+
+    def gather(self, x, dst: int) -> Tuple[Any, Work]:
+        import jax.numpy as jnp
+        from jax import lax
+
+        def f(t):
+            g = lax.all_gather(t[0], AXIS, axis=0, tiled=False)[None]  # (1, W, *s)
+            i = lax.axis_index(AXIS)
+            return jnp.where(i == dst, g, jnp.zeros_like(g))
+
+        out = self._build(("gather", dst), f)(x)
+        return out, ArrayWork(out, OpType.GATHER, "xla:gather")
+
+    def scatter(self, x, src: int) -> Tuple[Any, Work]:
+        from jax import lax
+
+        def f(t):  # t: (1, W, *s) — rank-local list of W chunks
+            g = lax.all_gather(t[0], AXIS, axis=0, tiled=False)  # (W, W, *s)
+            row = g[src]  # (W, *s) — src's chunk list
+            i = lax.axis_index(AXIS)
+            return lax.dynamic_slice_in_dim(row, i, 1, axis=0)  # (1, *s)
+
+        out = self._build(("scatter", src), f)(x)
+        return out, ArrayWork(out, OpType.SCATTER, "xla:scatter")
+
+    def reduce_scatter(self, x, op: Any = ReduceOp.SUM) -> Tuple[Any, Work]:
+        import jax.numpy as jnp
+        from jax import lax
+
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            W = self.world_size
+
+            def f(t):  # t: (1, W, *s); psum_scatter rides the ICI ring directly
+                r = lax.psum_scatter(t[0], AXIS, scatter_dimension=0, tiled=True)
+                # tiled=True keeps dim 0, now W/W == 1 per rank
+                if op == ReduceOp.AVG:
+                    r = r / W
+                return r
+
+        else:
+
+            def f(t):  # general ops: gather all chunk-lists, fold, slice own chunk
+                g = lax.all_gather(t[0], AXIS, axis=0, tiled=False)  # (W, W, *s)
+                if op == ReduceOp.MAX:
+                    r = jnp.max(g, axis=0)
+                elif op == ReduceOp.MIN:
+                    r = jnp.min(g, axis=0)
+                elif op == ReduceOp.PRODUCT:
+                    r = jnp.prod(g, axis=0)
+                elif op in (ReduceOp.BAND, ReduceOp.BOR, ReduceOp.BXOR):
+                    r = _fold_op(op)(g)[0]
+                else:
+                    raise NotImplementedError(f"reduce_scatter op {op}")
+                i = lax.axis_index(AXIS)
+                return lax.dynamic_slice_in_dim(r, i, 1, axis=0)
+
+        out = self._build(("reduce_scatter", op), f)(x)
+        return out, ArrayWork(out, OpType.REDUCE_SCATTER, "xla:reduce_scatter")
+
+    def alltoall(self, x) -> Tuple[Any, Work]:
+        from jax import lax
+
+        def f(t):  # t: (1, W, *s)
+            y = t[0]  # (W, *s); row j goes to rank j
+            out = lax.all_to_all(y, AXIS, split_axis=0, concat_axis=0, tiled=True)
+            return out[None]
+
+        out = self._build(("alltoall",), f)(x)
+        return out, ArrayWork(out, OpType.ALLTOALL, "xla:all_to_all")
+
+    def permute(self, x, perm: Sequence[Tuple[int, int]]) -> Tuple[Any, Work]:
+        import jax.numpy as jnp
+        from jax import lax
+
+        perm = tuple((int(s), int(d)) for s, d in perm)
+        receivers = tuple(sorted({d for _, d in perm}))
+
+        def f(t):
+            moved = lax.ppermute(t, AXIS, perm)
+            i = lax.axis_index(AXIS)
+            is_recv = jnp.zeros((), dtype=bool)
+            for d in receivers:
+                is_recv = is_recv | (i == d)
+            return jnp.where(is_recv, moved, t)
+
+        out = self._build(("permute", perm), f)(x)
+        return out, ArrayWork(out, OpType.SEND, "xla:permute")
+
+    def barrier(self) -> Work:
+        import jax.numpy as jnp
+        import numpy as np
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(
+            np.zeros((self.world_size, 1), np.float32),
+            NamedSharding(self.mesh.jax_mesh, P(AXIS)),
+        )
+        out, _ = self.allreduce(x, ReduceOp.SUM)
+        jax.block_until_ready(out)
+        return ArrayWork(out, OpType.BARRIER, "xla:barrier")

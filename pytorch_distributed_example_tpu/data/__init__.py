@@ -1,0 +1,3 @@
+from .sampler import DistributedSampler  # noqa: F401
+from .mnist import MNIST, SyntheticMNIST, load_mnist  # noqa: F401
+from .loader import DataLoader  # noqa: F401

@@ -1,0 +1,56 @@
+"""Minimal batched DataLoader over an index sampler.
+
+Plays the role torch's DataLoader plays in the reference's training loop
+(SURVEY.md §3.3): iterate sampler indices, gather into contiguous numpy
+batches. Device transfer happens once per step in the train loop
+(`jax.device_put` of the global batch with the dp sharding), which keeps
+host→HBM traffic to exactly one copy per step.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sampler: Optional[Iterable[int]] = None,
+        drop_last: bool = False,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        if self.sampler is not None:
+            indices = list(iter(self.sampler))
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            indices = rng.permutation(len(self.dataset)).tolist()
+            self._epoch += 1
+        else:
+            indices = list(range(len(self.dataset)))
+        for start in range(0, len(indices), self.batch_size):
+            batch_idx = indices[start : start + self.batch_size]
+            if self.drop_last and len(batch_idx) < self.batch_size:
+                break
+            idx = np.asarray(batch_idx)
+            x, y = self.dataset[idx]
+            yield x, y
+
+    def __len__(self) -> int:
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size

@@ -1,0 +1,738 @@
+"""c10d-shaped distributed API over XLA ICI collectives.
+
+Parity surface: `torch/distributed/distributed_c10d.py` (SURVEY.md §1-L1,
+§2.1 P1) — backend registry, `init_process_group` (`:1666`),
+`destroy_process_group` (`:2361`), rank/world queries (`:2552,:2579`),
+p2p (`:2598-2990`), collectives (`:3086-5358`), object collectives
+(`:3439,:3925,:4057`), `new_group` (`:5745`), `monitored_barrier` (`:5360`),
+and the `_World` singleton (`:673`).
+
+TPU-native model (SURVEY.md §7 hard part 4): two execution modes share this
+API —
+
+* **driver (SPMD) mode** — one Python process drives every device in the
+  mesh (the idiomatic single-controller JAX model). `world_size` = number
+  of devices; per-rank tensors are `DistTensor`s (rank-stacked, one shard
+  per device); collectives are compiled XLA programs that really move bytes
+  over ICI. `get_rank()` returns 0 — the driver acts for all ranks.
+* **multi-process mode** — one process per host à la `jax.distributed`
+  (multi-host pods); rank = process index; the same compiled programs run
+  over the global mesh. Bootstrapped via `init_method` rendezvous exactly
+  like the reference (`tcp://`, `env://`, `file://`).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import pickle
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import backends as _backends
+from .backends.base import Backend as _BackendBase
+from .mesh import DeviceMesh, init_device_mesh
+from .rendezvous import rendezvous as _rendezvous
+from .store import HashStore, PrefixStore, Store
+from .tensor import DistTensor
+from .types import ArrayWork, CompletedWork, OpType, ReduceOp, Work
+
+logger = logging.getLogger(__name__)
+
+# torch constants.py parity: default_pg_timeout == 30 minutes
+default_pg_timeout = datetime.timedelta(minutes=30)
+
+Backend = _backends  # registry module doubles as the Backend namespace
+register_backend = _backends.register_backend
+
+
+class GroupMember:
+    """Sentinels — torch `distributed_c10d.py` GroupMember."""
+
+    WORLD: Optional["ProcessGroup"] = None
+    NON_GROUP_MEMBER = object()
+
+
+class ProcessGroup:
+    """A set of ranks + their mesh + a concrete backend.
+
+    Parity: torch c10d `ProcessGroup.hpp:73` frontend (BackendType enum,
+    per-device backend dispatch) — here the "device" is always the group's
+    1-D mesh and there is exactly one backend instance per group.
+    """
+
+    def __init__(
+        self,
+        mesh: DeviceMesh,
+        ranks: List[int],
+        backend_name: str,
+        backend: _BackendBase,
+        store: Optional[Store],
+        name: str,
+        timeout: float,
+    ):
+        self.mesh = mesh.flattened("_ranks")
+        self.ranks = list(ranks)
+        self.backend_name = backend_name
+        self._backend = backend
+        self.store = store
+        self.group_name = name
+        self.timeout = timeout
+        self.bound_device_id = None
+
+    # -- identity ----------------------------------------------------------
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """The calling process's rank within this group (driver mode: 0)."""
+        w = _world
+        if w.mode == "driver":
+            return 0
+        try:
+            return self.ranks.index(w.process_rank)
+        except ValueError:
+            return -1
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank)
+
+    def get_global_rank(self, group_rank: int) -> int:
+        return self.ranks[group_rank]
+
+    @property
+    def backend_impl(self) -> _BackendBase:
+        return self._backend
+
+    def _check_member(self, rank: int) -> None:
+        if rank < 0 or rank >= self.size():
+            raise ValueError(f"rank {rank} out of range for group of size {self.size()}")
+
+    def __repr__(self):
+        return (
+            f"ProcessGroup(name={self.group_name!r}, backend={self.backend_name!r}, "
+            f"ranks={self.ranks})"
+        )
+
+
+@dataclass
+class _WorldState:
+    """Global PG bookkeeping — torch `_World` (`distributed_c10d.py:673`)."""
+
+    default_pg: Optional[ProcessGroup] = None
+    pg_map: Dict[str, ProcessGroup] = field(default_factory=dict)
+    pg_names: Dict[int, str] = field(default_factory=dict)
+    group_count: int = 0
+    mode: str = "driver"  # "driver" (single-controller SPMD) | "multiproc"
+    process_rank: int = 0
+    store: Optional[Store] = None
+
+
+_world = _WorldState()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+def is_initialized() -> bool:
+    return _world.default_pg is not None
+
+
+def _get_default_group() -> ProcessGroup:
+    if _world.default_pg is None:
+        raise RuntimeError(
+            "Default process group has not been initialized, "
+            "please make sure to call init_process_group."
+        )
+    return _world.default_pg
+
+
+def _resolve(group: Optional[ProcessGroup]) -> ProcessGroup:
+    if group is None or group is GroupMember.WORLD:
+        return _get_default_group()
+    return group
+
+
+def _timeout_seconds(timeout) -> float:
+    if timeout is None:
+        return default_pg_timeout.total_seconds()
+    if isinstance(timeout, datetime.timedelta):
+        return timeout.total_seconds()
+    return float(timeout)
+
+
+def init_process_group(
+    backend: Optional[str] = None,
+    init_method: Optional[str] = None,
+    timeout=None,
+    world_size: int = -1,
+    rank: int = -1,
+    store: Optional[Store] = None,
+    group_name: str = "",
+    device_mesh: Optional[DeviceMesh] = None,
+) -> ProcessGroup:
+    """Bring up the default process group.
+
+    Mirrors torch `init_process_group` (`distributed_c10d.py:1666`):
+    mutually-exclusive `store` vs `init_method`, PrefixStore namespacing
+    (`:1895`), rank-prefixed excepthook install (`:1924-1940`). Backend
+    strings "gloo"/"nccl" are accepted and alias to "xla" so the
+    reference's stock CLI (`--backend gloo`) runs unchanged.
+    """
+    import jax
+
+    global _world
+    if is_initialized():
+        raise RuntimeError("trying to initialize the default process group twice!")
+    if store is not None and init_method is not None:
+        raise ValueError("Cannot specify both init_method and store.")
+
+    backend = (backend or "xla").lower()
+    tsec = _timeout_seconds(timeout)
+
+    multiproc = jax.process_count() > 1
+    if multiproc:
+        _world.mode = "multiproc"
+        _world.process_rank = jax.process_index()
+        if world_size == -1:
+            world_size = jax.process_count()
+    else:
+        _world.mode = "driver"
+        _world.process_rank = 0
+        n_dev = len(jax.devices())
+        if world_size == -1:
+            world_size = n_dev
+        if world_size > n_dev:
+            raise ValueError(
+                f"world_size {world_size} exceeds visible devices {n_dev} "
+                "in driver (single-controller) mode"
+            )
+        if rank not in (-1, 0):
+            raise ValueError(
+                "driver mode: this process acts for all ranks; pass rank=0 or omit it"
+            )
+
+    # rendezvous → store (used for control traffic, debug wrapper, elastic)
+    if store is None:
+        if _world.mode == "multiproc":
+            # torch defaults init_method to env:// when neither store nor
+            # init_method is given (distributed_c10d.py:1666 docs); a private
+            # HashStore here would break all cross-process coordination.
+            store, rank, world_size = next(
+                iter(_rendezvous(init_method or "env://", rank, world_size, timeout=tsec))
+            )
+        else:
+            # driver mode: all ranks live in this process; in-process store
+            store = HashStore(tsec)
+    _world.store = store
+    prefixed = PrefixStore("default_pg", store)
+
+    if device_mesh is not None:
+        mesh = device_mesh
+    elif _world.mode == "driver":
+        mesh = init_device_mesh(("dp",), (world_size,), devices=jax.devices()[:world_size])
+    else:
+        mesh = init_device_mesh(("dp",), (len(jax.devices()),))
+
+    pg = _new_group_internal(
+        list(range(world_size)), backend, prefixed, "default_pg", tsec, mesh
+    )
+    _world.default_pg = pg
+    GroupMember.WORLD = pg
+    _install_rank_excepthook()
+    return pg
+
+
+def _new_group_internal(
+    ranks: List[int],
+    backend_name: str,
+    store: Optional[Store],
+    name: str,
+    tsec: float,
+    mesh: Optional[DeviceMesh] = None,
+) -> ProcessGroup:
+    import jax
+
+    if mesh is None:
+        world = _get_default_group()
+        mesh = world.mesh.submesh([world.ranks.index(r) if r in world.ranks else r for r in ranks])
+    flat = mesh.flattened("_ranks")
+    backend = _backends.create_backend(backend_name, flat, 0, len(ranks), tsec)
+    pg = ProcessGroup(flat, ranks, backend_name, backend, store, name, tsec)
+    _world.pg_map[name] = pg
+    _world.pg_names[id(pg)] = name
+    _world.group_count += 1
+    return pg
+
+
+def new_group(
+    ranks: Optional[Sequence[int]] = None,
+    timeout=None,
+    backend: Optional[str] = None,
+    group_desc: Optional[str] = None,
+) -> ProcessGroup:
+    """Create a subgroup — torch `new_group` (`distributed_c10d.py:5745`)."""
+    world = _get_default_group()
+    if ranks is None:
+        ranks = list(world.ranks)
+    ranks = sorted(int(r) for r in ranks)
+    for r in ranks:
+        if r not in world.ranks:
+            raise ValueError(f"rank {r} not in world {world.ranks}")
+    name = group_desc or f"group_{_world.group_count}"
+    tsec = _timeout_seconds(timeout) if timeout is not None else world.timeout
+    store = (
+        PrefixStore(name, _world.store) if _world.store is not None else None
+    )
+    submesh = world.mesh.submesh([world.ranks.index(r) for r in ranks])
+    return _new_group_internal(
+        ranks, backend or world.backend_name, store, name, tsec, submesh
+    )
+
+
+def new_subgroups(
+    group_size: Optional[int] = None, timeout=None, backend: Optional[str] = None
+) -> Tuple[ProcessGroup, List[ProcessGroup]]:
+    """Split the world into equal contiguous subgroups — torch
+    `new_subgroups` (`distributed_c10d.py:6103`). Returns (the calling
+    rank's subgroup, all subgroups); in driver mode the caller holds every
+    rank, so "its" subgroup is defined as the first."""
+    world = _get_default_group()
+    W = world.size()
+    if group_size is None:
+        raise ValueError("group_size required")
+    if W % group_size != 0:
+        raise ValueError(f"world size {W} not divisible by group_size {group_size}")
+    groups = []
+    cur = None
+    me = _world.process_rank
+    for start in range(0, W, group_size):
+        rs = range(start, start + group_size)
+        g = new_group(rs, timeout=timeout, backend=backend)
+        groups.append(g)
+        if me in rs:
+            cur = g
+    return (cur if cur is not None else groups[0]), groups
+
+
+def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
+    """torch `destroy_process_group` (`distributed_c10d.py:2361`)."""
+    global _world
+    if group is None or group is _world.default_pg or group is GroupMember.WORLD:
+        for pg in _world.pg_map.values():
+            pg.backend_impl.shutdown()
+        st = _world.store
+        if st is not None and hasattr(st, "close"):
+            try:
+                st.close()
+            except Exception:
+                pass
+        _world = _WorldState()
+        GroupMember.WORLD = None
+    else:
+        group.backend_impl.shutdown()
+        _world.pg_map.pop(group.group_name, None)
+
+
+def get_rank(group: Optional[ProcessGroup] = None) -> int:
+    if not is_initialized():
+        return -1
+    return _resolve(group).rank()
+
+
+def get_world_size(group: Optional[ProcessGroup] = None) -> int:
+    if not is_initialized():
+        return -1
+    return _resolve(group).size()
+
+
+def get_backend(group: Optional[ProcessGroup] = None) -> str:
+    return _resolve(group).backend_name
+
+
+def get_process_group_ranks(group: Optional[ProcessGroup] = None) -> List[int]:
+    return list(_resolve(group).ranks)
+
+
+def _install_rank_excepthook() -> None:
+    """Rank-prefixed excepthook — torch `distributed_c10d.py:1924-1940`."""
+    if getattr(_install_rank_excepthook, "_installed", False):
+        return
+    old_hook = sys.excepthook
+
+    def _hook(exc_type, exc_value, exc_tb):
+        prefix = f"[rank{_world.process_rank}]"
+        old_stderr_write = sys.stderr.write
+        try:
+            sys.stderr.write(f"{prefix}: ")
+        except Exception:
+            pass
+        old_hook(exc_type, exc_value, exc_tb)
+
+    sys.excepthook = _hook
+    _install_rank_excepthook._installed = True
+
+
+# ---------------------------------------------------------------------------
+# tensor coercion helpers
+# ---------------------------------------------------------------------------
+
+
+def _as_dist(tensor, group: ProcessGroup) -> DistTensor:
+    if isinstance(tensor, DistTensor):
+        return tensor
+    raise TypeError(
+        "collectives in driver mode take DistTensor (per-rank tensors packed "
+        "rank-major); build one with DistTensor.from_rank_fn / from_stacked"
+    )
+
+
+def _finish(dt: DistTensor, out, work: Work, async_op: bool):
+    dt._set(out)
+    if async_op:
+        return work
+    # sync path: dispatch already enqueued; like torch we return None.
+    # correctness does not require a host block (reads block on data).
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):
+    """torch `all_reduce` (`distributed_c10d.py:3156`) — in-place on the
+    DistTensor; lowers to `lax.psum`/`pmean`/... over the group mesh."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.allreduce(dt.array, op)
+    return _finish(dt, out, work, async_op)
+
+
+def broadcast(tensor, src: int, group=None, async_op: bool = False):
+    """torch `broadcast` (`distributed_c10d.py:3086`)."""
+    g = _resolve(group)
+    g._check_member(src)
+    dt = _as_dist(tensor, g)
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.broadcast(dt.array, src)
+    return _finish(dt, out, work, async_op)
+
+
+def reduce(tensor, dst: int, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):
+    """torch `reduce` (`distributed_c10d.py:3337`) — only dst's slot holds
+    the reduction; other ranks keep their input."""
+    g = _resolve(group)
+    g._check_member(dst)
+    dt = _as_dist(tensor, g)
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.reduce(dt.array, dst, op)
+    return _finish(dt, out, work, async_op)
+
+
+def all_gather(tensor, group=None, async_op: bool = False) -> Union[DistTensor, Tuple[DistTensor, Work]]:
+    """torch `all_gather` (`distributed_c10d.py:4192`). Returns a new
+    DistTensor whose per-rank value is the stacked (world, *shape) gather
+    (the rank axis replaces torch's output tensor list)."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.allgather(dt.array)
+    res = DistTensor(out, g)
+    return (res, work) if async_op else res
+
+
+def gather(tensor, dst: int = 0, group=None, async_op: bool = False):
+    """torch `gather` (`distributed_c10d.py:4568`): dst's slot holds the
+    stacked gather; other slots are zeros."""
+    g = _resolve(group)
+    g._check_member(dst)
+    dt = _as_dist(tensor, g)
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.gather(dt.array, dst)
+    res = DistTensor(out, g)
+    return (res, work) if async_op else res
+
+
+def scatter(tensor, src: int = 0, group=None, async_op: bool = False):
+    """torch `scatter` (`distributed_c10d.py:4672`): input per-rank value is
+    a (world, *shape) chunk list (only src's row matters); each rank
+    receives its chunk."""
+    g = _resolve(group)
+    g._check_member(src)
+    dt = _as_dist(tensor, g)
+    if dt.shape[0] != g.size():
+        raise ValueError(
+            f"scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
+        )
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.scatter(dt.array, src)
+    res = DistTensor(out, g)
+    return (res, work) if async_op else res
+
+
+def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):
+    """torch `reduce_scatter` (`distributed_c10d.py:4790`): input per-rank
+    value is a (world, *shape) chunk list; output is each rank's reduced
+    chunk. SUM/AVG ride `lax.psum_scatter` (ICI-native)."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    if dt.shape[0] != g.size():
+        raise ValueError(
+            f"reduce_scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
+        )
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.reduce_scatter(dt.array, op)
+    res = DistTensor(out, g)
+    return (res, work) if async_op else res
+
+
+def all_to_all(tensor, group=None, async_op: bool = False):
+    """torch `all_to_all` (`distributed_c10d.py:5145`): per-rank value is a
+    (world, *shape) list; row j of rank i goes to rank j's row i. Lowers to
+    `lax.all_to_all` (ICI-native)."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    if dt.shape[0] != g.size():
+        raise ValueError(
+            f"all_to_all input per-rank leading dim {dt.shape[0]} != world {g.size()}"
+        )
+    g.backend_impl.next_sequence_number()
+    out, work = g.backend_impl.alltoall(dt.array)
+    res = DistTensor(out, g)
+    return (res, work) if async_op else res
+
+
+def barrier(group=None, async_op: bool = False, device_ids=None):
+    """torch `barrier` (`distributed_c10d.py:5284`)."""
+    g = _resolve(group)
+    g.backend_impl.next_sequence_number()
+    work = g.backend_impl.barrier()
+    return work if async_op else None
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False):
+    """torch `monitored_barrier` (`distributed_c10d.py:5360`). In driver
+    mode all ranks are this process, so arrival is trivially simultaneous;
+    in multiproc mode this goes through the store with per-rank arrival keys
+    so the failing rank is nameable."""
+    g = _resolve(group)
+    if _world.mode == "driver" or g.store is None:
+        barrier(g)
+        return
+    tsec = _timeout_seconds(timeout) if timeout is not None else g.timeout
+    me = g.rank()
+    g.store.set(f"mb/{g.backend_impl.get_sequence_number_for_group()}/{me}", b"1")
+    missing = []
+    for r in range(g.size()):
+        key = f"mb/{g.backend_impl.get_sequence_number_for_group()}/{r}"
+        try:
+            g.store.wait([key], tsec)
+        except Exception:
+            missing.append(r)
+            if not wait_all_ranks:
+                break
+    if missing:
+        raise RuntimeError(f"monitored_barrier: rank(s) {missing} failed to arrive")
+
+
+# ---------------------------------------------------------------------------
+# point-to-point
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class P2POp:
+    """torch `P2POp` (`distributed_c10d.py:2875`): one half of a p2p pair.
+
+    `op` is `isend` or `irecv`; `peer` is the other rank. In driver mode
+    the acting rank must be given explicitly via `rank` (the driver holds
+    all ranks, so "self" is ambiguous — SURVEY.md §7 hard part 4).
+    """
+
+    op: Any
+    tensor: DistTensor
+    peer: int
+    group: Optional[ProcessGroup] = None
+    tag: int = 0
+    rank: Optional[int] = None
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Work]:
+    """torch `batch_isend_irecv` (`distributed_c10d.py:2990`): pair up the
+    sends/recvs and execute them as ONE `lax.ppermute` over the mesh —
+    the ICI-native form of a p2p batch."""
+    if not p2p_op_list:
+        return []
+    g = _resolve(p2p_op_list[0].group)
+    sends: Dict[Tuple[int, int, int], P2POp] = {}
+    recvs: Dict[Tuple[int, int, int], P2POp] = {}
+    for p in p2p_op_list:
+        if p.rank is None:
+            raise ValueError("driver mode: P2POp.rank (acting rank) is required")
+        is_send = getattr(p.op, "__name__", str(p.op)) in ("isend", "send")
+        if is_send:
+            sends[(p.rank, p.peer, p.tag)] = p
+        else:
+            recvs[(p.peer, p.rank, p.tag)] = p
+
+    pairs = []
+    recv_targets = []
+    for key, s in sends.items():
+        r = recvs.get(key)
+        if r is None:
+            raise RuntimeError(f"unmatched isend {key}; driver mode requires paired ops")
+        pairs.append((key[0], key[1]))
+        recv_targets.append(r)
+    if len(recvs) != len(sends):
+        raise RuntimeError("unmatched irecv in batch")
+
+    dt = sends[next(iter(sends))].tensor if sends else None
+    # all ops must share one DistTensor in driver mode (one program, one array);
+    # heterogeneous tensors: run one permute per tensor object
+    works: List[Work] = []
+    by_tensor: Dict[int, List[Tuple[Tuple[int, int], P2POp, P2POp]]] = {}
+    for key, s in sends.items():
+        r = recvs[key]
+        by_tensor.setdefault(id(s.tensor), []).append(((key[0], key[1]), s, r))
+    for _, entries in by_tensor.items():
+        perm = [p for p, _, _ in entries]
+        src_dt = entries[0][1].tensor
+        out, work = g.backend_impl.permute(src_dt.array, perm)
+        for _, s, r in entries:
+            r.tensor._set(out)
+        works.append(work)
+    return works
+
+
+def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = None):
+    """torch `send` (`distributed_c10d.py:2598`). Driver mode: requires the
+    acting rank via `src` and executes immediately as a ppermute pair."""
+    g = _resolve(group)
+    if src is None:
+        raise ValueError("driver mode: send(...) needs src= (acting rank)")
+    dt = _as_dist(tensor, g)
+    out, work = g.backend_impl.permute(dt.array, [(src, dst)])
+    dt._set(out)
+    return None
+
+
+def recv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Optional[int] = None) -> int:
+    """torch `recv` (`distributed_c10d.py:2682`). Driver mode: the matching
+    send already routed data into the rank-stacked array (send+recv are one
+    ppermute), so this is a no-op returning the source rank."""
+    return src if src is not None else -1
+
+
+def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = None) -> Work:
+    g = _resolve(group)
+    if src is None:
+        raise ValueError("driver mode: isend(...) needs src= (acting rank)")
+    dt = _as_dist(tensor, g)
+    out, work = g.backend_impl.permute(dt.array, [(src, dst)])
+    dt._set(out)
+    return work
+
+
+def irecv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Optional[int] = None) -> Work:
+    return CompletedWork(tensor, OpType.RECV)
+
+
+# ---------------------------------------------------------------------------
+# object collectives — torch `distributed_c10d.py:3439,3925,4057`
+# ---------------------------------------------------------------------------
+
+
+def _obj_to_array(obj) -> np.ndarray:
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+
+
+def _array_to_obj(arr: np.ndarray, length: int):
+    return pickle.loads(arr[:length].tobytes())
+
+
+def all_gather_object(objects: Sequence[Any], group=None) -> List[Any]:
+    """torch `all_gather_object` (`:3439`). Driver mode: `objects[r]` is
+    rank r's object; returns the gathered list (what every rank would see).
+    Exercises the real tensor path: pickle → uint8 DistTensor → length
+    all_reduce(MAX) → padded all_gather → unpickle."""
+    g = _resolve(group)
+    W = g.size()
+    if len(objects) != W:
+        raise ValueError(f"need one object per rank ({W}), got {len(objects)}")
+    bufs = [_obj_to_array(o) for o in objects]
+    lens = np.array([len(b) for b in bufs], dtype=np.int64)
+    # max length via all_reduce(MAX) over a per-rank length tensor
+    lt = DistTensor.from_stacked(lens[:, None], g)
+    all_reduce(lt, ReduceOp.MAX, g)
+    max_len = int(lt.numpy()[0, 0])
+    padded = np.zeros((W, max_len), dtype=np.uint8)
+    for i, b in enumerate(bufs):
+        padded[i, : len(b)] = b
+    dt = DistTensor.from_stacked(padded, g)
+    gathered = all_gather(dt, g)  # per-rank (W, max_len)
+    flat = gathered.numpy()[0]  # all ranks identical
+    return [_array_to_obj(flat[i], int(lens[i])) for i in range(W)]
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0, group=None) -> None:
+    """torch `broadcast_object_list` (`:3925`). Driver mode: `object_list`
+    is the per-rank slot list; after the call every slot holds src's
+    object (routed through a real broadcast collective)."""
+    g = _resolve(group)
+    W = g.size()
+    if len(object_list) != W:
+        raise ValueError(f"need one slot per rank ({W}), got {len(object_list)}")
+    bufs = [_obj_to_array(o) for o in object_list]
+    max_len = max(len(b) for b in bufs)
+    lens = np.array([len(b) for b in bufs], dtype=np.int64)
+    lt = DistTensor.from_stacked(lens[:, None], g)
+    broadcast(lt, src, g)
+    src_len = int(lt.numpy()[0, 0])
+    padded = np.zeros((W, max(max_len, 1)), dtype=np.uint8)
+    for i, b in enumerate(bufs):
+        padded[i, : len(b)] = b
+    dt = DistTensor.from_stacked(padded, g)
+    broadcast(dt, src, g)
+    out = dt.numpy()
+    for i in range(W):
+        object_list[i] = _array_to_obj(out[i], src_len)
+
+
+def scatter_object_list(
+    scatter_object_output_list: List[Any],
+    scatter_object_input_list: Optional[List[Any]] = None,
+    src: int = 0,
+    group=None,
+) -> None:
+    """torch `scatter_object_list` (`:4057`). Driver mode:
+    `scatter_object_input_list` is src's list of W objects; output list gets
+    one object per rank."""
+    g = _resolve(group)
+    W = g.size()
+    if scatter_object_input_list is None or len(scatter_object_input_list) != W:
+        raise ValueError(f"src must provide {W} objects")
+    bufs = [_obj_to_array(o) for o in scatter_object_input_list]
+    max_len = max(len(b) for b in bufs)
+    chunk = np.zeros((W, W, max_len + 8), dtype=np.uint8)
+    for i, b in enumerate(bufs):
+        chunk[src, i, :8] = np.frombuffer(
+            np.int64(len(b)).tobytes(), dtype=np.uint8
+        )
+        chunk[src, i, 8 : 8 + len(b)] = b
+    dt = DistTensor.from_stacked(chunk, g)
+    res = scatter(dt, src, g)  # per-rank (1? ...) -> (max_len+8,)
+    out = res.numpy()  # (W, 1, max_len+8) or (W, max_len+8)
+    out = out.reshape(W, -1)
+    del scatter_object_output_list[:]
+    for i in range(W):
+        ln = int(np.frombuffer(out[i, :8].tobytes(), dtype=np.int64)[0])
+        scatter_object_output_list.append(_array_to_obj(out[i, 8:], ln))

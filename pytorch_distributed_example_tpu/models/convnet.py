@@ -1,0 +1,39 @@
+"""The reference MNIST ConvNet, rebuilt in flax (NHWC, TPU-native layout).
+
+Parity surface: the reference's `Net` in mnist/main.py [RECONSTRUCTED,
+SURVEY.md §2.0 E2] — the canonical torch MNIST example topology:
+conv(1→10, k5) → maxpool2 → relu → conv(10→20, k5) → dropout → maxpool2 →
+relu → fc(320→50) → relu → dropout → fc(50→10) → log_softmax.
+
+Differences that are deliberate TPU choices, not omissions:
+  - NHWC layout (flax/XLA-TPU native; torch is NCHW),
+  - logits returned raw; log_softmax folds into the loss
+    (optax.softmax_cross_entropy_with_integer_labels) so XLA fuses it.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: (B, 28, 28, 1)
+        x = nn.Conv(features=10, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(features=20, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # (B, 320)
+        x = nn.Dense(features=50)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(features=self.num_classes)(x)
+        return x

@@ -1,0 +1,2 @@
+from .ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401
+from . import comm_hooks  # noqa: F401

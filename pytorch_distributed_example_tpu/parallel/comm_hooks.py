@@ -1,0 +1,73 @@
+"""DDP communication hooks.
+
+Parity surface: torch builtin comm hooks — C++ ALLREDUCE / FP16_COMPRESS
+(`default_comm_hooks.hpp:9-34`) and the Python hook set
+(`torch/distributed/algorithms/ddp_comm_hooks/default_hooks.py`)
+(SURVEY.md §2.2 N16, §2.1 P6).
+
+TPU-native shape: a hook is `hook(grads_pytree, axis_name) -> grads_pytree`
+that REPLACES the default gradient reduction *inside the compiled train
+step* (SURVEY.md §2.2 N7 note: "comm hook = psum inside the compiled step").
+Compression hooks cast before the psum so the bytes crossing ICI are
+half-width, then cast back — the same wire saving FP16_COMPRESS buys on
+NCCL, but fused into the step by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Hook = Callable
+
+
+def allreduce_hook(grads, axis_name: str):
+    """Default: mean over the dp axis (allreduce ÷ world, torch
+    `default_hooks.py:allreduce_hook`)."""
+    return lax.pmean(grads, axis_name)
+
+
+def bf16_compress_hook(grads, axis_name: str):
+    """bfloat16-compressed allreduce (torch `bf16_compress_hook`): halves
+    ICI bytes; bf16 is the TPU-native half type (MXU accumulates fp32)."""
+    orig = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+    small = jax.tree_util.tree_map(lambda g: g.astype(jnp.bfloat16), grads)
+    red = lax.pmean(small, axis_name)
+    return jax.tree_util.tree_map(lambda g, d: g.astype(d), red, orig)
+
+
+def fp16_compress_hook(grads, axis_name: str):
+    """float16-compressed allreduce (torch FP16_COMPRESS,
+    `default_comm_hooks.hpp:9-34`). On TPU prefer bf16 (no overflow
+    scaling needed); fp16 kept for parity."""
+    orig = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+    small = jax.tree_util.tree_map(lambda g: g.astype(jnp.float16), grads)
+    red = lax.pmean(small, axis_name)
+    return jax.tree_util.tree_map(lambda g, d: g.astype(d), red, orig)
+
+
+def quantize_hook(bits: int = 8):
+    """Uniform stochastic-free int quantization hook (inspired by
+    PowerSGD-family bandwidth reduction, torch `powerSGD_hook.py`): scale
+    per-leaf to int8, sum as int32, rescale. Lossy; for experimentation."""
+
+    def hook(grads, axis_name: str):
+        def q(g):
+            local = jnp.maximum(jnp.max(jnp.abs(g)), 1e-8) / (2 ** (bits - 1) - 1)
+            scale = lax.pmax(local, axis_name)  # shared scale so the sum is coherent
+            qg = jnp.round(g / scale).astype(jnp.int32)
+            s = lax.psum(qg, axis_name)
+            n = lax.psum(jnp.ones((), g.dtype), axis_name)
+            return (s.astype(g.dtype) * scale) / n
+
+        return jax.tree_util.tree_map(q, grads)
+
+    return hook
+
+
+def noop_hook(grads, axis_name: str):
+    """No reduction (single-rank groups / debugging)."""
+    return grads

@@ -1,0 +1,354 @@
+"""DistributedDataParallel — replicated-model data parallelism, TPU-native.
+
+Parity surface: `torch/nn/parallel/distributed.py:466-2666` + the C++
+Reducer (`reducer.hpp:45-624`) — SURVEY.md §1-L5, §2.1 P3, §2.2 N6/N7.
+
+Architecture note (SURVEY.md §7 step 5): torch's DDP exists to retrofit
+communication onto an eager autograd engine — per-param hooks, flat bucket
+buffers, a pending countdown, async allreduce overlapped with backward.
+Under XLA none of that machinery is needed to get the same (better)
+schedule: the train step is ONE compiled program in which gradient `pmean`
+ops are fused and overlapped with remaining backward compute by XLA's
+latency-hiding scheduler. So:
+
+  * fast path (this file): `make_ddp_train_step` compiles
+    forward+backward+reduce+update into one program over the group mesh —
+    the functional equivalent of DDP.forward + Reducer + optimizer.step.
+    Comm hooks (`register_comm_hook`, torch `distributed.py:2178`) slot in
+    as the gradient-reduction function inside the program.
+  * parity path (`parallel/reducer.py`): an explicit bucketed Reducer for
+    eager/interop use, matching bucket-cap semantics (25 MiB cap / 1 MiB
+    first bucket).
+
+Construction-time parity behaviors kept (they catch real bugs):
+  * cross-rank parameter shape verification
+    (`_verify_param_shape_across_processes`, torch `distributed.py:1064`)
+    — a shape-fingerprint allreduce(MIN)==allreduce(MAX) check;
+  * rank-0 parameter broadcast (`_sync_module_states`,
+    torch `distributed.py:1066`) through the real broadcast collective;
+  * `no_sync()` gradient-accumulation context (torch `distributed.py:1659`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import hashlib
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from ..tensor import DistTensor
+from ..types import ReduceOp
+from . import comm_hooks
+
+
+def _shard_map():
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm  # type: ignore
+
+    return sm
+
+
+def _param_fingerprint(params) -> np.ndarray:
+    """Stable hash of the param pytree's structure+shapes+dtypes."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    desc = str(treedef) + "|" + "|".join(
+        f"{tuple(l.shape)}:{l.dtype}" for l in leaves
+    )
+    h = hashlib.sha256(desc.encode()).digest()[:8]
+    return np.frombuffer(h, dtype=np.int64).astype(np.float64)
+
+
+def make_ddp_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    group=None,
+    comm_hook: Optional[Callable] = None,
+    has_rng: bool = False,
+    with_aux: bool = False,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
+):
+    """Compile a data-parallel train step over the group's mesh.
+
+    `apply_fn(params, x, rng?) -> logits`; `loss_fn(logits, y) -> scalar`
+    (or `(scalar, aux)` with `with_aux`). Returns
+    `step(params, opt_state, x, y[, rng]) -> (params, opt_state, loss[, aux])`
+    with params/opt_state replicated and x/y sharded over the dp axis.
+
+    The gradient reduction (default `pmean` = allreduce-SUM ÷ world, the
+    Reducer's finalize semantics, torch `reducer.hpp:289,538`) happens
+    INSIDE the compiled program, so XLA buckets and overlaps it with the
+    remaining backward — the schedule torch's Reducer implements by hand.
+
+    `grad_accum_steps > 1` is the compiled-path equivalent of torch's
+    `no_sync()` gradient accumulation (`distributed.py:1659`): the local
+    batch is scanned in `grad_accum_steps` microbatches, gradients
+    accumulate locally, and ONE reduction runs at the end — the same
+    bandwidth saving, with correct replicated-params semantics.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    import optax
+
+    from .. import distributed as dist
+
+    g = dist._resolve(group)
+    mesh = g.mesh.jax_mesh
+    axis = g.mesh.axis_names[0]
+    hook = comm_hook or comm_hooks.allreduce_hook
+
+    def local_step(params, opt_state, x, y, rng):
+        def objective(p, xm, ym, step_i):
+            if has_rng:
+                # per-device, per-microbatch independent dropout streams
+                dev_rng = jax.random.fold_in(rng, lax.axis_index(axis))
+                dev_rng = jax.random.fold_in(dev_rng, step_i)
+                logits = apply_fn(p, xm, dev_rng)
+            else:
+                logits = apply_fn(p, xm)
+            out = loss_fn(logits, ym)
+            return out if with_aux else (out, None)
+
+        obj = jax.checkpoint(objective) if remat else objective
+
+        if grad_accum_steps > 1:
+            import jax.numpy as jnp
+
+            xb = x.reshape((grad_accum_steps, -1) + x.shape[1:])
+            yb = y.reshape((grad_accum_steps, -1) + y.shape[1:])
+
+            def micro(carry, inp):
+                gsum, lsum, i = carry
+                xm, ym = inp
+                (l, aux), gr = jax.value_and_grad(obj, has_aux=True)(
+                    params, xm, ym, i
+                )
+                gsum = jax.tree_util.tree_map(lambda a, b: a + b, gsum, gr)
+                return (gsum, lsum + l, i + 1), aux
+
+            zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (gsum, lsum, _), auxs = lax.scan(
+                micro, (zero, 0.0, 0), (xb, yb)
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum_steps, gsum)
+            loss = lsum / grad_accum_steps
+            aux = auxs
+        else:
+            (loss, aux), grads = jax.value_and_grad(obj, has_aux=True)(
+                params, x, y, 0
+            )
+        grads = hook(grads, axis)
+        loss = lax.pmean(loss, axis)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss, aux
+
+    sm = _shard_map()
+    mapped = sm(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    jitted = jax.jit(mapped, donate_argnums=(0, 1))
+
+    if has_rng:
+
+        def step(params, opt_state, x, y, rng):
+            p, o, l, aux = jitted(params, opt_state, x, y, rng)
+            return (p, o, l, aux) if with_aux else (p, o, l)
+
+    else:
+        _dummy = None
+
+        def step(params, opt_state, x, y):
+            import jax.numpy as jnp
+
+            nonlocal _dummy
+            if _dummy is None:
+                _dummy = jax.random.PRNGKey(0)
+            p, o, l, aux = jitted(params, opt_state, x, y, _dummy)
+            return (p, o, l, aux) if with_aux else (p, o, l)
+
+    step.mesh = mesh
+    step.axis = axis
+    return step
+
+
+def make_eval_step(apply_fn: Callable, metric_fn: Callable, group=None):
+    """Compile a data-parallel eval step — the reference's `metric tensors
+    all_reduce'd for global avg` (SURVEY.md §3.3 eval).
+
+    `metric_fn(logits, y, w) -> vector of weighted SUMS` where `w` is a
+    per-sample weight (0 for padding samples); the step psums across the
+    mesh. Summing (not averaging) + an explicit weight makes padded tail
+    batches exact: pad the batch to a devisible size, zero the pad weights,
+    divide by the true count at the end.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from .. import distributed as dist
+
+    g = dist._resolve(group)
+    mesh = g.mesh.jax_mesh
+    axis = g.mesh.axis_names[0]
+
+    def local_eval(params, x, y, w):
+        logits = apply_fn(params, x)
+        m = metric_fn(logits, y, w)
+        return lax.psum(m, axis)
+
+    sm = _shard_map()
+    mapped = sm(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+class DistributedDataParallel:
+    """Module wrapper with torch-DDP construction semantics.
+
+    Wraps a flax module + params: verifies param consistency across ranks,
+    broadcasts rank-0 params, replicates them over the group mesh, and
+    hands out compiled train/eval steps. `no_sync()` and
+    `register_comm_hook` match torch's surface
+    (`distributed.py:1659,2178`).
+    """
+
+    def __init__(
+        self,
+        module,
+        params,
+        process_group=None,
+        broadcast_params: bool = True,
+        find_unused_parameters: bool = False,
+        bucket_cap_mb: float = 25.0,
+    ):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import distributed as dist
+
+        self.module = module
+        self.process_group = dist._resolve(process_group)
+        self.find_unused_parameters = find_unused_parameters
+        self.bucket_cap_mb = bucket_cap_mb
+        self._comm_hook: Optional[Callable] = None
+        self._require_grad_sync = True
+
+        g = self.process_group
+
+        # (a) verify param shapes across ranks (torch distributed.py:1064):
+        # fingerprint allreduce(MIN) must equal allreduce(MAX)
+        fp = _param_fingerprint(params)
+        lo = DistTensor.replicate(fp, g)
+        hi = DistTensor.replicate(fp, g)
+        dist.all_reduce(lo, ReduceOp.MIN, g)
+        dist.all_reduce(hi, ReduceOp.MAX, g)
+        if not np.array_equal(lo.numpy()[0], hi.numpy()[0]):
+            raise RuntimeError(
+                "DDP: parameter structure differs across ranks "
+                "(fingerprint mismatch)"
+            )
+
+        # (b) broadcast rank-0 params (torch distributed.py:1066). In driver
+        # mode ranks share one param copy, but we still route a broadcast
+        # through the backend so construction exercises the collective.
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        if broadcast_params and flat:
+            probe = DistTensor.replicate(
+                np.asarray(jax.device_get(flat[0])).ravel()[:16], g
+            )
+            dist.broadcast(probe, 0, g)
+
+        # (c) replicate params over the mesh (HBM-resident, sharding P()).
+        # jit identity (not device_put) so the replicas are FRESH buffers:
+        # device_put may alias the caller's device-0 buffer into the copy,
+        # and the train step donates its params input — aliased buffers
+        # would delete the caller's arrays out from under it.
+        sharding = NamedSharding(g.mesh.jax_mesh, P())
+        self.params = jax.jit(lambda p: p, out_shardings=sharding)(params)
+
+        # (d) eager-path bucketed Reducer (torch reducer.hpp; 25 MiB cap)
+        from .reducer import Reducer
+
+        self.reducer = Reducer(process_group=g, bucket_cap_mb=bucket_cap_mb)
+
+    # -- torch surface -----------------------------------------------------
+    def __call__(self, x, *args, **kwargs):
+        return self.module.apply(self.params, x, *args, **kwargs)
+
+    def register_comm_hook(self, state, hook: Callable) -> None:
+        """torch `register_comm_hook` (`distributed.py:2178`); hook signature
+        here is `hook(grads, axis_name) -> reduced_grads`."""
+        if state is not None:
+            hook = functools.partial(hook, state)
+        self._comm_hook = hook
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """torch `no_sync` (`distributed.py:1659`): gradient reductions
+        issued through `reduce_gradients` (the eager Reducer path) inside
+        this context are skipped, so grads accumulate locally. For the
+        compiled fast path, use `make_train_step(..., grad_accum_steps=N)`
+        instead — same bandwidth saving, fused into one program."""
+        old = self._require_grad_sync
+        self._require_grad_sync = False
+        try:
+            yield
+        finally:
+            self._require_grad_sync = old
+
+    def reduce_gradients(self, grads):
+        """Eager bucketed mean-allreduce of a rank-stacked grad pytree
+        (leaves shaped (world, *param_shape)); honors `no_sync()`."""
+        return self.reducer.reduce(grads, require_sync=self._require_grad_sync)
+
+    @property
+    def require_backward_grad_sync(self) -> bool:
+        return self._require_grad_sync
+
+    def make_train_step(self, optimizer, loss_fn, has_rng: bool = False, **kw):
+        apply = (
+            (lambda p, x, rng: self.module.apply(p, x, train=True, rngs={"dropout": rng}))
+            if has_rng
+            else (lambda p, x: self.module.apply(p, x))
+        )
+        return make_ddp_train_step(
+            apply,
+            loss_fn,
+            optimizer,
+            group=self.process_group,
+            comm_hook=self._comm_hook,
+            has_rng=has_rng,
+            **kw,
+        )
+
+    def make_eval_step(self, metric_fn):
+        return make_eval_step(
+            lambda p, x: self.module.apply(p, x),
+            metric_fn,
+            group=self.process_group,
+        )
+
+    def state_dict(self):
+        import jax
+
+        return jax.device_get(self.params)

@@ -1,0 +1,188 @@
+"""Bucketed gradient Reducer — the eager/interop parity path.
+
+Parity surface: torch's C++ Reducer (`reducer.hpp:45-624`, SURVEY.md §2.2
+N6/N7): size-capped bucket assignment (`_compute_bucket_assignment_by_size`,
+used at `nn/parallel/distributed.py:1422`; 25 MiB cap, 1 MiB first bucket —
+`distributed.py:31`, `_DEFAULT_FIRST_BUCKET_BYTES`), reversed bucket order
+approximating backward production order (`distributed.py:1436-1438`), flat
+per-bucket gradient buffers (`Bucket` struct `reducer.hpp:356-424`), async
+per-bucket allreduce overlapped with the rest of backward
+(`all_reduce_bucket` `reducer.hpp:538`), comm-hook futures, and the
+finalize step that divides by world size and scatters buckets back
+(`finalize_backward` `reducer.hpp:289`).
+
+TPU-native reinterpretation: JAX has no autograd hooks (SURVEY.md §7 hard
+part 3), so the Reducer operates post-grad on the gradient pytree. Overlap
+still happens: each bucket's allreduce is dispatched async (XLA enqueues and
+returns), so bucket N's ICI transfer overlaps bucket N+1's host-side
+flatten/dispatch, and `finalize` blocks only at the end. In jit mode none of
+this is needed (the fused step's pmean is the fast path) — this class exists
+for eager workflows, interop, and semantic parity (no_sync, comm hooks,
+bucket introspection for the DDP Logger).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensor import DistTensor
+from ..types import ReduceOp, Work
+
+DEFAULT_BUCKET_CAP_MB = 25.0  # torch nn/parallel/distributed.py:31
+DEFAULT_FIRST_BUCKET_BYTES = 1024 * 1024  # torch dist._DEFAULT_FIRST_BUCKET_BYTES
+
+
+def compute_bucket_assignment_by_size(
+    sizes_bytes: Sequence[int],
+    bucket_cap_bytes: float = DEFAULT_BUCKET_CAP_MB * 1024 * 1024,
+    first_bucket_bytes: float = DEFAULT_FIRST_BUCKET_BYTES,
+) -> List[List[int]]:
+    """Greedy size-capped bucketing — torch
+    `_compute_bucket_assignment_by_size` (bound in reducer.hpp, SURVEY.md
+    N6). The first bucket gets a smaller cap so the first allreduce launches
+    early in backward."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0.0
+    cap = first_bucket_bytes
+    for i, sz in enumerate(sizes_bytes):
+        if cur and cur_bytes + sz > cap:
+            buckets.append(cur)
+            cur = []
+            cur_bytes = 0.0
+            cap = bucket_cap_bytes
+        cur.append(i)
+        cur_bytes += sz
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+@dataclass
+class Bucket:
+    """Flat bucket of gradient leaves — torch `Bucket` (reducer.hpp:356)."""
+
+    leaf_indices: List[int]
+    offsets: List[int]
+    lengths: List[int]
+    shapes: List[Tuple[int, ...]]
+    total: int
+    pending_work: Optional[Work] = None
+    flat: Any = None  # rank-stacked (W, total) array while in flight
+
+
+class Reducer:
+    """Post-grad bucketed allreduce over a process group.
+
+    `reduce(grads)` takes a *rank-stacked* gradient pytree (every leaf shaped
+    `(world, *param_shape)`, i.e. per-rank grads packed like DistTensor) and
+    returns the same pytree with every rank's slot holding the mean.
+    """
+
+    def __init__(
+        self,
+        process_group=None,
+        bucket_cap_mb: float = DEFAULT_BUCKET_CAP_MB,
+        first_bucket_bytes: int = DEFAULT_FIRST_BUCKET_BYTES,
+        comm_hook: Optional[Callable] = None,
+        gradient_as_bucket_view: bool = False,
+    ):
+        from .. import distributed as dist
+
+        self.group = dist._resolve(process_group)
+        self.bucket_cap_bytes = bucket_cap_mb * 1024 * 1024
+        self.first_bucket_bytes = first_bucket_bytes
+        self.comm_hook = comm_hook
+        self.gradient_as_bucket_view = gradient_as_bucket_view
+        self._rebuilt = False
+        self._buckets_spec: Optional[List[List[int]]] = None
+        # DDP Logger food (torch logger.hpp:42-90)
+        self.stats = {
+            "num_buckets": 0,
+            "bucket_sizes": [],
+            "reduce_calls": 0,
+            "rebuilds": 0,
+        }
+
+    # -- bucket planning ---------------------------------------------------
+    def build_buckets(self, leaves) -> List[List[int]]:
+        """Plan buckets over gradient leaves in REVERSED order (torch
+        reverses params to approximate backward production order,
+        distributed.py:1436-1438)."""
+        sizes = [int(np.prod(l.shape[1:]) or 1) * l.dtype.itemsize for l in leaves]
+        order = list(range(len(leaves)))[::-1]
+        assignment_rev = compute_bucket_assignment_by_size(
+            [sizes[i] for i in order], self.bucket_cap_bytes, self.first_bucket_bytes
+        )
+        assignment = [[order[j] for j in b] for b in assignment_rev]
+        self._buckets_spec = assignment
+        self.stats["num_buckets"] = len(assignment)
+        self.stats["bucket_sizes"] = [
+            sum(sizes[i] for i in b) for b in assignment
+        ]
+        self.stats["rebuilds"] += 1
+        self._rebuilt = True
+        return assignment
+
+    # -- the reduction -----------------------------------------------------
+    def reduce(self, grads, require_sync: bool = True):
+        """Bucketed mean-allreduce of a rank-stacked grad pytree.
+
+        With `require_sync=False` (the `no_sync()` context, torch
+        `distributed.py:1659`) communication is skipped entirely and the
+        local grads are returned unchanged — accumulation is the caller's
+        (optimizer's) business, as in torch.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads
+        if not require_sync:
+            return grads
+        self.stats["reduce_calls"] += 1
+        if self._buckets_spec is None or not self._rebuilt:
+            self.build_buckets(leaves)
+
+        W = self.group.size()
+        backend = self.group.backend_impl
+        in_flight: List[Bucket] = []
+
+        # dispatch ALL buckets async first (overlap: ICI transfer of bucket k
+        # runs while we flatten/dispatch bucket k+1)
+        for idx_list in self._buckets_spec:
+            shapes = [tuple(leaves[i].shape[1:]) for i in idx_list]
+            lengths = [int(np.prod(s) or 1) for s in shapes]
+            offsets = list(np.cumsum([0] + lengths[:-1]))
+            flat = jnp.concatenate(
+                [leaves[i].reshape(W, -1) for i in idx_list], axis=1
+            )
+            if self.comm_hook is not None:
+                out, work = self.comm_hook(backend, flat)
+            else:
+                out, work = backend.allreduce(flat, ReduceOp.AVG)
+            in_flight.append(
+                Bucket(idx_list, offsets, lengths, shapes, sum(lengths), work, out)
+            )
+
+        # finalize: wait + scatter back (torch finalize_backward)
+        new_leaves = list(leaves)
+        for b in in_flight:
+            b.pending_work.wait()
+            for i, off, ln, shp in zip(b.leaf_indices, b.offsets, b.lengths, b.shapes):
+                new_leaves[i] = b.flat[:, off : off + ln].reshape((W,) + shp)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def reduce_dist_tensors(self, grads_dt: List[DistTensor], require_sync: bool = True) -> None:
+        """In-place variant over DistTensors (torch-style mutation)."""
+        import jax
+
+        tree = [dt.array for dt in grads_dt]
+        red = self.reduce(tree, require_sync)
+        for dt, arr in zip(grads_dt, red):
+            dt._set(arr)

@@ -1,0 +1,124 @@
+"""DistTensor — a group's per-rank tensors as one sharded jax.Array.
+
+TPU-native resolution of SURVEY.md §7 hard part 4 (process-vs-mesh
+identity): in torch c10d each process owns one rank's tensor; on TPU one
+process drives a whole mesh. A DistTensor packs "rank r's tensor" for every
+r into a single array of shape `(world, *per_rank_shape)`, sharded one rank
+per device over the group's 1-D mesh (`NamedSharding(mesh, P("_ranks"))`).
+Eager collectives are then compiled XLA programs over that array — shard i
+physically lives in device i's HBM, so an all_reduce really moves bytes
+across ICI exactly like a per-process c10d collective would.
+
+The wrapper is *mutable* so the torch in-place idiom works:
+
+    t = DistTensor.from_rank_fn(lambda r: jnp.ones((4,)) * r)
+    dist.all_reduce(t)      # t now holds the sum on every rank
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class DistTensor:
+    def __init__(self, array, group=None):
+        self._array = array
+        self._group = group
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_rank_fn(cls, fn: Callable[[int], Any], group=None) -> "DistTensor":
+        """Build from a per-rank initializer: fn(rank) -> array-like."""
+        group = _resolve_group(group)
+        vals = [np.asarray(fn(r)) for r in range(group.size())]
+        return cls.from_stacked(np.stack(vals), group)
+
+    @classmethod
+    def from_stacked(cls, stacked, group=None) -> "DistTensor":
+        """Build from an array whose leading axis indexes ranks."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        group = _resolve_group(group)
+        stacked = np.asarray(stacked)
+        if stacked.shape[0] != group.size():
+            raise ValueError(
+                f"leading axis {stacked.shape[0]} != world size {group.size()}"
+            )
+        sharding = NamedSharding(group.mesh.jax_mesh, P("_ranks"))
+        arr = jax.device_put(stacked, sharding)
+        return cls(arr, group)
+
+    @classmethod
+    def replicate(cls, value, group=None) -> "DistTensor":
+        """Same value on every rank."""
+        group = _resolve_group(group)
+        v = np.asarray(value)
+        return cls.from_stacked(np.broadcast_to(v, (group.size(),) + v.shape), group)
+
+    @classmethod
+    def wrap(cls, array, group=None) -> "DistTensor":
+        """Adopt an existing rank-stacked jax.Array (no copy)."""
+        return cls(array, _resolve_group(group))
+
+    # -- views -------------------------------------------------------------
+    @property
+    def array(self):
+        return self._array
+
+    @property
+    def group(self):
+        return self._group
+
+    @property
+    def shape(self):
+        """Per-rank shape."""
+        return tuple(self._array.shape[1:])
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def world_size(self) -> int:
+        return self._array.shape[0]
+
+    def numpy(self) -> np.ndarray:
+        """Full (world, *shape) host copy."""
+        import jax
+
+        return np.asarray(jax.device_get(self._array))
+
+    def unstack(self) -> List[np.ndarray]:
+        """Per-rank host copies — `[t_rank0, t_rank1, ...]`."""
+        full = self.numpy()
+        return [full[i] for i in range(full.shape[0])]
+
+    def rank_local(self, rank: int) -> np.ndarray:
+        return self.numpy()[rank]
+
+    def block_until_ready(self) -> "DistTensor":
+        import jax
+
+        jax.block_until_ready(self._array)
+        return self
+
+    # -- mutation (in-place collective support) ----------------------------
+    def _set(self, new_array) -> None:
+        self._array = new_array
+
+    def __repr__(self):
+        return (
+            f"DistTensor(world={self.world_size}, shape={self.shape}, "
+            f"dtype={self.dtype})"
+        )
+
+
+def _resolve_group(group):
+    if group is not None:
+        return group
+    from . import distributed as dist
+
+    return dist._get_default_group()

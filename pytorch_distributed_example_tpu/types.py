@@ -1,0 +1,191 @@
+"""Core distributed types: ReduceOp, OpType, Work.
+
+Parity surface (reference stack, see SURVEY.md §2.2 N3/N4):
+  - `ReduceOp` algebra incl. PREMUL_SUM — torch c10d `Types.hpp:37-54`.
+  - `OpType` enum — torch c10d `Work.hpp:15-37`.
+  - `Work` async handle (`isCompleted`/`isSuccess`/`wait`/`synchronize`/
+    `result`/`exception`) — torch c10d `Work.hpp:57-194`.
+
+TPU-native mapping: a collective dispatched eagerly through the XLA backend
+returns immediately with async device buffers (XLA dispatch is async by
+construction), so `Work.wait()` is `jax.block_until_ready` on the result
+arrays rather than a condition variable on a comm thread.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+class ReduceOp(enum.Enum):
+    """Reduction algebra for all_reduce / reduce / reduce_scatter.
+
+    Same member set as torch c10d `Types.hpp:37-54`. On TPU:
+      SUM/AVG/MIN/MAX lower to `lax.psum` / `lax.pmean` / `lax.pmin` /
+      `lax.pmax` over the mesh axis; PRODUCT and the bitwise ops lower to an
+      `all_gather` + local fold (rare ops, no dedicated ICI primitive);
+      PREMUL_SUM scales by a factor then psums (NCCL semantics).
+    """
+
+    SUM = "sum"
+    AVG = "avg"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    BAND = "band"
+    BOR = "bor"
+    BXOR = "bxor"
+    PREMUL_SUM = "premul_sum"
+
+    def __call__(self, factor: float) -> "_PremulSum":
+        if self is not ReduceOp.PREMUL_SUM:
+            raise TypeError(f"{self} is not parameterizable")
+        return _PremulSum(factor)
+
+
+@dataclass(frozen=True)
+class _PremulSum:
+    """PREMUL_SUM with its scale factor (c10d `_make_nccl_premul_sum`)."""
+
+    factor: float
+
+    @property
+    def base(self) -> ReduceOp:
+        return ReduceOp.PREMUL_SUM
+
+
+class OpType(enum.Enum):
+    """Collective op kinds — torch c10d `Work.hpp:15-37`."""
+
+    BROADCAST = enum.auto()
+    ALLREDUCE = enum.auto()
+    ALLREDUCE_COALESCED = enum.auto()
+    REDUCE = enum.auto()
+    ALLGATHER = enum.auto()
+    _ALLGATHER_BASE = enum.auto()
+    ALLGATHER_COALESCED = enum.auto()
+    GATHER = enum.auto()
+    SCATTER = enum.auto()
+    REDUCE_SCATTER = enum.auto()
+    ALLTOALL_BASE = enum.auto()
+    ALLTOALL = enum.auto()
+    SEND = enum.auto()
+    RECV = enum.auto()
+    BARRIER = enum.auto()
+    UNKNOWN = enum.auto()
+
+
+class Work:
+    """Async handle for a dispatched collective.
+
+    Mirrors torch c10d `Work.hpp:57` (`isCompleted` `:69`, `wait`,
+    `synchronize` `:100`, `result`, `exception`). The XLA backend's
+    concrete subclass wraps async jax.Arrays: the collective program has
+    already been enqueued to the device when the Work is returned, and
+    `wait()` blocks the host until the output buffers are ready.
+    """
+
+    def __init__(self, op_type: OpType = OpType.UNKNOWN, profiling_title: str = ""):
+        self._op_type = op_type
+        self._profiling_title = profiling_title
+        self._start = time.monotonic()
+
+    # -- interface ---------------------------------------------------------
+    def is_completed(self) -> bool:
+        raise NotImplementedError
+
+    def is_success(self) -> bool:
+        return self.exception() is None
+
+    def exception(self) -> Optional[BaseException]:
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        raise NotImplementedError
+
+    def synchronize(self) -> None:
+        self.wait()
+
+    def result(self) -> Any:
+        raise NotImplementedError
+
+    # torch-style aliases
+    isCompleted = is_completed
+    isSuccess = is_success
+
+    @property
+    def op_type(self) -> OpType:
+        return self._op_type
+
+    @property
+    def profiling_title(self) -> str:
+        return self._profiling_title
+
+
+class ArrayWork(Work):
+    """Work over already-dispatched jax.Arrays (the XLA backend's handle)."""
+
+    def __init__(
+        self,
+        result: Any,
+        op_type: OpType = OpType.UNKNOWN,
+        profiling_title: str = "",
+        on_complete: Optional[Callable[[], None]] = None,
+    ):
+        super().__init__(op_type, profiling_title)
+        self._result = result
+        self._exception: Optional[BaseException] = None
+        self._waited = False
+        self._on_complete = on_complete
+
+    def is_completed(self) -> bool:
+        if self._waited:
+            return True
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self._result)
+        return all(getattr(x, "is_ready", lambda: True)() for x in leaves)
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exception
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._waited:
+            return True
+        import jax
+
+        try:
+            jax.block_until_ready(self._result)
+        except BaseException as e:  # XLA error surfaces here
+            self._exception = e
+            raise
+        finally:
+            self._waited = True
+            if self._on_complete is not None:
+                cb, self._on_complete = self._on_complete, None
+                cb()
+        return True
+
+    def result(self) -> Any:
+        self.wait()
+        return self._result
+
+
+class CompletedWork(Work):
+    """Immediately-complete Work (barrier fast paths, fake backend)."""
+
+    def __init__(self, result: Any = None, op_type: OpType = OpType.UNKNOWN):
+        super().__init__(op_type)
+        self._result = result
+
+    def is_completed(self) -> bool:
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def result(self) -> Any:
+        return self._result

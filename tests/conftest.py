@@ -1,0 +1,21 @@
+"""Test fixtures: a shared default process group over the 8-device CPU mesh.
+
+(Platform forcing happens in the repo-root conftest.py, which runs first.)
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def world():
+    """Session-scoped default process group (8 virtual devices)."""
+    import pytorch_distributed_example_tpu as tdx
+
+    if not tdx.is_initialized():
+        tdx.init_process_group(backend="xla")
+    yield tdx.distributed._get_default_group()
+
+
+@pytest.fixture(scope="session")
+def world_size(world):
+    return world.size()

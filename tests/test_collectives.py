@@ -1,0 +1,263 @@
+"""Collective semantics tests on the virtual 8-device CPU mesh.
+
+Analog of torch's MultiThreadedTestCase-based collective suite
+(SURVEY.md §4.2): every collective checked against a numpy reference model,
+one process, N virtual ranks.
+"""
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu.types import ReduceOp
+
+
+def _per_rank(world_size, shape=(4,), dtype=np.float32, offset=0):
+    return tdx.DistTensor.from_rank_fn(
+        lambda r: np.full(shape, float(r + 1 + offset), dtype=dtype)
+    )
+
+
+class TestAllReduce:
+    def test_sum(self, world_size):
+        t = _per_rank(world_size)
+        tdx.all_reduce(t)
+        expect = sum(range(1, world_size + 1))
+        for r, v in enumerate(t.unstack()):
+            np.testing.assert_allclose(v, expect)
+
+    def test_avg(self, world_size):
+        t = _per_rank(world_size)
+        tdx.all_reduce(t, ReduceOp.AVG)
+        expect = sum(range(1, world_size + 1)) / world_size
+        np.testing.assert_allclose(t.numpy(), expect)
+
+    def test_max_min(self, world_size):
+        t = _per_rank(world_size)
+        tdx.all_reduce(t, ReduceOp.MAX)
+        np.testing.assert_allclose(t.numpy(), world_size)
+        t = _per_rank(world_size)
+        tdx.all_reduce(t, ReduceOp.MIN)
+        np.testing.assert_allclose(t.numpy(), 1.0)
+
+    def test_product(self, world_size):
+        t = _per_rank(world_size)
+        tdx.all_reduce(t, ReduceOp.PRODUCT)
+        expect = float(np.prod(np.arange(1, world_size + 1, dtype=np.float64)))
+        np.testing.assert_allclose(t.numpy(), expect)
+
+    def test_premul_sum(self, world_size):
+        t = _per_rank(world_size)
+        tdx.all_reduce(t, ReduceOp.PREMUL_SUM(2.0))
+        expect = 2.0 * sum(range(1, world_size + 1))
+        np.testing.assert_allclose(t.numpy(), expect)
+
+    def test_bitwise(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([1 << r], dtype=np.int32))
+        tdx.all_reduce(t, ReduceOp.BOR)
+        np.testing.assert_array_equal(t.numpy(), (1 << world_size) - 1)
+
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([3], dtype=np.int32))
+        tdx.all_reduce(t, ReduceOp.BAND)
+        np.testing.assert_array_equal(t.numpy(), 3)
+
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([1], dtype=np.int32))
+        tdx.all_reduce(t, ReduceOp.BXOR)
+        np.testing.assert_array_equal(t.numpy(), 0 if world_size % 2 == 0 else 1)
+
+    def test_async(self, world_size):
+        t = _per_rank(world_size)
+        work = tdx.all_reduce(t, async_op=True)
+        assert work.wait()
+        assert work.is_completed()
+        assert work.is_success()
+        np.testing.assert_allclose(t.numpy(), sum(range(1, world_size + 1)))
+
+    def test_multidim(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.full((3, 5, 2), r, dtype=np.float32)
+        )
+        tdx.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), sum(range(world_size)))
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("src", [0, 3, 7])
+    def test_broadcast(self, world_size, src):
+        t = _per_rank(world_size)
+        tdx.broadcast(t, src=src)
+        np.testing.assert_allclose(t.numpy(), src + 1)
+
+
+class TestReduce:
+    def test_reduce_dst(self, world_size):
+        t = _per_rank(world_size)
+        tdx.reduce(t, dst=2)
+        vals = t.unstack()
+        np.testing.assert_allclose(vals[2], sum(range(1, world_size + 1)))
+        # non-dst ranks keep their input (torch semantics)
+        for r in range(world_size):
+            if r != 2:
+                np.testing.assert_allclose(vals[r], r + 1)
+
+
+class TestAllGather:
+    def test_all_gather(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.array([r, 10 * r], dtype=np.float32)
+        )
+        out = tdx.all_gather(t)
+        assert out.shape == (world_size, 2)
+        expect = np.stack(
+            [np.array([r, 10 * r], dtype=np.float32) for r in range(world_size)]
+        )
+        for r in range(world_size):
+            np.testing.assert_allclose(out.rank_local(r), expect)
+
+    def test_gather_dst_only(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([r], dtype=np.float32))
+        out = tdx.gather(t, dst=1)
+        np.testing.assert_allclose(
+            out.rank_local(1).ravel(), np.arange(world_size, dtype=np.float32)
+        )
+        np.testing.assert_allclose(out.rank_local(0), 0.0)
+
+
+class TestScatter:
+    def test_scatter(self, world_size):
+        chunks = np.arange(world_size * world_size, dtype=np.float32).reshape(
+            world_size, world_size, 1
+        )
+        t = tdx.DistTensor.from_stacked(chunks)
+        out = tdx.scatter(t, src=2)
+        for r in range(world_size):
+            np.testing.assert_allclose(out.rank_local(r).ravel(), chunks[2, r])
+
+
+class TestReduceScatter:
+    def test_sum(self, world_size):
+        data = np.arange(world_size * world_size, dtype=np.float32).reshape(
+            world_size, world_size, 1
+        )
+        t = tdx.DistTensor.from_stacked(data)
+        out = tdx.reduce_scatter(t)
+        for r in range(world_size):
+            np.testing.assert_allclose(out.rank_local(r).ravel(), data[:, r].sum())
+
+    def test_max(self, world_size):
+        data = np.arange(world_size * world_size, dtype=np.float32).reshape(
+            world_size, world_size, 1
+        )
+        t = tdx.DistTensor.from_stacked(data)
+        out = tdx.reduce_scatter(t, ReduceOp.MAX)
+        for r in range(world_size):
+            np.testing.assert_allclose(out.rank_local(r).ravel(), data[:, r].max())
+
+
+class TestAllToAll:
+    def test_all_to_all(self, world_size):
+        data = np.arange(world_size * world_size, dtype=np.float32).reshape(
+            world_size, world_size, 1
+        )
+        t = tdx.DistTensor.from_stacked(data)
+        out = tdx.all_to_all(t)
+        for r in range(world_size):
+            np.testing.assert_allclose(out.rank_local(r).ravel(), data[:, r].ravel())
+
+
+class TestP2P:
+    def test_send_recv(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([float(r)], np.float32))
+        tdx.send(t, dst=5, src=1)
+        vals = t.unstack()
+        assert vals[5].item() == 1.0
+        assert vals[0].item() == 0.0  # untouched
+
+    def test_batch_isend_irecv(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([float(r)], np.float32))
+        ops = [
+            tdx.P2POp(tdx.isend, t, peer=1, rank=0),
+            tdx.P2POp(tdx.irecv, t, peer=0, rank=1),
+            tdx.P2POp(tdx.isend, t, peer=3, rank=2),
+            tdx.P2POp(tdx.irecv, t, peer=2, rank=3),
+        ]
+        works = tdx.batch_isend_irecv(ops)
+        for w in works:
+            w.wait()
+        vals = t.unstack()
+        assert vals[1].item() == 0.0  # got rank 0's value
+        assert vals[3].item() == 2.0  # got rank 2's value
+        assert vals[5].item() == 5.0  # uninvolved rank untouched
+
+    def test_ring_permute(self, world_size):
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([float(r)], np.float32))
+        g = tdx.distributed._get_default_group()
+        perm = [(i, (i + 1) % world_size) for i in range(world_size)]
+        out, work = g.backend_impl.permute(t.array, perm)
+        work.wait()
+        t._set(out)
+        vals = t.unstack()
+        for r in range(world_size):
+            assert vals[r].item() == float((r - 1) % world_size)
+
+
+class TestBarrier:
+    def test_barrier(self, world_size):
+        tdx.barrier()
+
+    def test_monitored_barrier(self, world_size):
+        tdx.monitored_barrier()
+
+
+class TestGroups:
+    def test_new_group_subset(self, world_size):
+        g = tdx.new_group([0, 2, 4, 6])
+        assert g.size() == 4
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.array([float(r + 1)], np.float32), g
+        )
+        tdx.all_reduce(t, group=g)
+        np.testing.assert_allclose(t.numpy(), 1 + 2 + 3 + 4)
+
+    def test_new_subgroups(self, world_size):
+        first, groups = tdx.new_subgroups(group_size=4)
+        assert len(groups) == world_size // 4
+        assert first.size() == 4
+        for g in groups:
+            t = tdx.DistTensor.from_rank_fn(lambda r: np.ones((2,), np.float32), g)
+            tdx.all_reduce(t, group=g)
+            np.testing.assert_allclose(t.numpy(), 4.0)
+
+    def test_group_rank_translation(self, world_size):
+        g = tdx.new_group([1, 3, 5])
+        assert g.get_global_rank(0) == 1
+        assert g.get_group_rank(5) == 2
+
+
+class TestObjectCollectives:
+    def test_all_gather_object(self, world_size):
+        objs = [{"rank": r, "data": list(range(r))} for r in range(world_size)]
+        out = tdx.all_gather_object(objs)
+        assert out == objs
+
+    def test_broadcast_object_list(self, world_size):
+        lists = [f"rank{r}-payload" for r in range(world_size)]
+        tdx.broadcast_object_list(lists, src=3)
+        assert all(v == "rank3-payload" for v in lists)
+
+    def test_scatter_object_list(self, world_size):
+        inp = [{"for": r} for r in range(world_size)]
+        out = []
+        tdx.scatter_object_list(out, inp, src=0)
+        assert out == inp
+
+
+class TestWorldApi:
+    def test_rank_world(self, world_size):
+        assert tdx.get_rank() == 0  # driver mode
+        assert tdx.get_world_size() == world_size
+        assert tdx.get_backend() == "xla"
+
+    def test_double_init_raises(self, world):
+        with pytest.raises(RuntimeError):
+            tdx.init_process_group()

@@ -1,0 +1,35 @@
+"""North-star constraint: zero torch/CUDA/NCCL symbols in the framework.
+
+BASELINE.json: "zero CUDA/NCCL symbols imported". SURVEY.md §7 hard part 5:
+parity tests that compare against torch live test-side only; the framework
+itself must never import torch. Verified in a clean subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_framework_does_not_import_torch():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys\n"
+        "import pytorch_distributed_example_tpu as tdx\n"
+        "import pytorch_distributed_example_tpu.models\n"
+        "import pytorch_distributed_example_tpu.data\n"
+        "import pytorch_distributed_example_tpu.parallel\n"
+        "import pytorch_distributed_example_tpu.backends\n"
+        "bad = [m for m in sys.modules if m == 'torch' or m.startswith('torch.')]\n"
+        "assert not bad, f'torch leaked into import graph: {bad[:5]}'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "clean" in out.stdout

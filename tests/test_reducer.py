@@ -1,0 +1,99 @@
+"""Bucketed Reducer tests — bucket planning + bucketed allreduce parity.
+
+Covers torch `_compute_bucket_assignment_by_size` semantics and the
+Reducer's finalize (mean, scatter-back) — SURVEY.md §2.2 N6/N7.
+"""
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu.parallel.reducer import (
+    DEFAULT_FIRST_BUCKET_BYTES,
+    Reducer,
+    compute_bucket_assignment_by_size,
+)
+
+
+class TestBucketAssignment:
+    def test_first_bucket_smaller(self):
+        # 1 MiB first cap, 25 MiB rest (torch defaults)
+        mb = 1024 * 1024
+        sizes = [mb // 2, mb // 2, mb // 2, 10 * mb, 10 * mb, 10 * mb, 10 * mb]
+        buckets = compute_bucket_assignment_by_size(sizes)
+        assert buckets[0] == [0, 1]  # 1 MiB first bucket fills at 2 × 0.5 MiB
+        total = [i for b in buckets for i in b]
+        assert total == list(range(len(sizes)))  # order preserved, all covered
+        for b in buckets[1:]:
+            assert sum(sizes[i] for i in b) <= 25 * mb
+
+    def test_oversize_leaf_gets_own_bucket(self):
+        mb = 1024 * 1024
+        sizes = [30 * mb, 30 * mb]
+        buckets = compute_bucket_assignment_by_size(sizes)
+        assert buckets == [[0], [1]]
+
+    def test_single_small(self):
+        assert compute_bucket_assignment_by_size([100]) == [[0]]
+
+
+class TestReducer:
+    def _rank_stacked(self, world, shape, fn):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        g = tdx.distributed._get_default_group()
+        arr = np.stack([fn(r).astype(np.float32) for r in range(world)])
+        return jax.device_put(arr, NamedSharding(g.mesh.jax_mesh, P("_ranks")))
+
+    def test_reduce_means_across_ranks(self, world):
+        W = world.size()
+        grads = {
+            "a": self._rank_stacked(W, (4,), lambda r: np.full((4,), r)),
+            "b": self._rank_stacked(W, (2, 3), lambda r: np.full((2, 3), 2.0 * r)),
+        }
+        red = Reducer()
+        out = red.reduce(grads)
+        mean = np.mean(np.arange(W))
+        np.testing.assert_allclose(np.asarray(out["a"]), mean)
+        np.testing.assert_allclose(np.asarray(out["b"]), 2.0 * mean)
+        assert red.stats["num_buckets"] >= 1
+        assert red.stats["reduce_calls"] == 1
+
+    def test_many_leaves_multiple_buckets(self, world):
+        W = world.size()
+        # leaves sized to force >1 bucket with a tiny cap
+        leaves = [
+            self._rank_stacked(W, (1000,), lambda r, i=i: np.full((1000,), r + i))
+            for i in range(8)
+        ]
+        red = Reducer(bucket_cap_mb=0.01, first_bucket_bytes=2000)
+        out = red.reduce(leaves)
+        assert red.stats["num_buckets"] > 1
+        mean_r = np.mean(np.arange(W))
+        for i, leaf in enumerate(out):
+            np.testing.assert_allclose(np.asarray(leaf), mean_r + i)
+
+    def test_no_sync_skips(self, world):
+        W = world.size()
+        grads = [self._rank_stacked(W, (5,), lambda r: np.full((5,), r))]
+        red = Reducer()
+        out = red.reduce(grads, require_sync=False)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(grads[0]))
+        assert red.stats["reduce_calls"] == 0
+
+    def test_comm_hook_used(self, world):
+        from pytorch_distributed_example_tpu.types import ReduceOp
+
+        W = world.size()
+        calls = []
+
+        def hook(backend, flat):
+            calls.append(flat.shape)
+            return backend.allreduce(flat, ReduceOp.AVG)
+
+        grads = [self._rank_stacked(W, (5,), lambda r: np.full((5,), r))]
+        red = Reducer(comm_hook=hook)
+        out = red.reduce(grads)
+        assert calls, "comm hook was not invoked"
+        np.testing.assert_allclose(np.asarray(out[0]), np.mean(np.arange(W)))

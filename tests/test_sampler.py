@@ -1,0 +1,97 @@
+"""DistributedSampler semantics — cross-checked against torch's.
+
+torch is installed in this environment (SURVEY.md §0) and is used here as a
+*test oracle only* — the framework itself never imports torch (BASELINE
+north star: zero torch/CUDA/NCCL symbols in the import graph; see
+tests/test_no_torch_import.py).
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu.data import DistributedSampler
+
+
+class _Sized:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+class TestSamplerSemantics:
+    @pytest.mark.parametrize("n,world", [(100, 4), (101, 4), (7, 8), (64, 8)])
+    def test_cover_and_padding(self, n, world):
+        ds = _Sized(n)
+        samplers = [
+            DistributedSampler(ds, num_replicas=world, rank=r, shuffle=False)
+            for r in range(world)
+        ]
+        all_idx = [list(iter(s)) for s in samplers]
+        lengths = {len(a) for a in all_idx}
+        assert len(lengths) == 1  # equal per-rank length
+        total = sum(len(a) for a in all_idx)
+        assert total == samplers[0].total_size
+        covered = set()
+        for a in all_idx:
+            covered.update(a)
+        assert covered == set(range(n))  # full cover (with padding reuse)
+
+    def test_strided_assignment_unshuffled(self):
+        ds = _Sized(16)
+        s1 = DistributedSampler(ds, num_replicas=4, rank=1, shuffle=False)
+        assert list(iter(s1)) == [1, 5, 9, 13]
+
+    def test_epoch_determinism(self):
+        ds = _Sized(50)
+        a = DistributedSampler(ds, num_replicas=2, rank=0, seed=7)
+        b = DistributedSampler(ds, num_replicas=2, rank=0, seed=7)
+        a.set_epoch(3)
+        b.set_epoch(3)
+        assert list(iter(a)) == list(iter(b))
+        b.set_epoch(4)
+        assert list(iter(a)) != list(iter(b))
+
+    def test_drop_last(self):
+        ds = _Sized(10)
+        samplers = [
+            DistributedSampler(ds, num_replicas=4, rank=r, shuffle=False, drop_last=True)
+            for r in range(4)
+        ]
+        for s in samplers:
+            assert len(s) == 2
+        total = [i for s in samplers for i in iter(s)]
+        assert len(total) == 8
+        assert len(set(total)) == 8  # no padding duplicates
+
+    def test_invalid_rank(self):
+        with pytest.raises(ValueError):
+            DistributedSampler(_Sized(10), num_replicas=2, rank=2)
+
+
+class TestTorchOracle:
+    """Structural equivalence with torch.utils.data.DistributedSampler."""
+
+    @pytest.mark.parametrize("n,world,drop", [(100, 4, False), (101, 4, False),
+                                              (10, 4, True), (64, 8, False)])
+    def test_lengths_match_torch(self, n, world, drop):
+        torch_data = pytest.importorskip("torch.utils.data")
+        ds = _Sized(n)
+        for r in range(world):
+            ours = DistributedSampler(ds, num_replicas=world, rank=r, drop_last=drop)
+            theirs = torch_data.DistributedSampler(
+                ds, num_replicas=world, rank=r, drop_last=drop
+            )
+            assert len(ours) == len(theirs)
+            assert ours.total_size == theirs.total_size
+
+    def test_unshuffled_order_matches_torch(self):
+        torch_data = pytest.importorskip("torch.utils.data")
+        ds = _Sized(22)
+        for r in range(4):
+            ours = DistributedSampler(ds, num_replicas=4, rank=r, shuffle=False)
+            theirs = torch_data.DistributedSampler(
+                ds, num_replicas=4, rank=r, shuffle=False
+            )
+            assert list(iter(ours)) == list(iter(theirs))

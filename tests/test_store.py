@@ -1,0 +1,209 @@
+"""Store family tests — HashStore, FileStore, PrefixStore, TCPStore.
+
+Analog of torch's store tests over the c10d Store interface
+(Store.hpp:19-127 semantics: set/get/add/wait/check/compare_set).
+TCPStore is exercised client↔daemon over real sockets in-process, and
+cross-process via a spawned client (SURVEY.md §4.1 methodology).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from pytorch_distributed_example_tpu.store import (
+    FileStore,
+    HashStore,
+    PrefixStore,
+    StoreTimeoutError,
+    TCPStore,
+)
+
+
+def _exercise(store):
+    store.set("k1", b"v1")
+    assert store.get("k1") == b"v1"
+    store.set("k1", "v2")
+    assert store.get("k1") == b"v2"
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 5) == 6
+    assert store.check(["k1", "ctr"])
+    assert not store.check(["nope"])
+    store.wait(["k1"], timeout=1.0)
+    with pytest.raises(StoreTimeoutError):
+        store.wait(["missing"], timeout=0.2)
+    # compare_set: miss then hit
+    assert store.compare_set("cas", "", "a") == b"a"
+    assert store.compare_set("cas", "wrong", "b") == b"a"
+    assert store.compare_set("cas", "a", "b") == b"b"
+    assert store.delete_key("k1")
+    assert not store.check(["k1"])
+    assert store.num_keys() >= 2
+
+
+class TestHashStore:
+    def test_basic(self):
+        _exercise(HashStore(timeout=2.0))
+
+    def test_blocking_get(self):
+        s = HashStore(timeout=5.0)
+        got = []
+
+        def reader():
+            got.append(s.get("later"))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        s.set("later", b"now")
+        t.join(2.0)
+        assert got == [b"now"]
+
+
+class TestFileStore:
+    def test_basic(self, tmp_path):
+        _exercise(FileStore(str(tmp_path / "fs"), timeout=2.0))
+
+    def test_two_handles_share_state(self, tmp_path):
+        p = str(tmp_path / "fs2")
+        a = FileStore(p, timeout=2.0)
+        b = FileStore(p, timeout=2.0)
+        a.set("x", b"1")
+        assert b.get("x") == b"1"
+        assert b.add("n", 2) == 2
+        assert a.add("n", 3) == 5
+
+
+class TestPrefixStore:
+    def test_namespacing(self):
+        base = HashStore(timeout=2.0)
+        p1 = PrefixStore("a", base)
+        p2 = PrefixStore("b", base)
+        p1.set("k", b"1")
+        p2.set("k", b"2")
+        assert p1.get("k") == b"1"
+        assert p2.get("k") == b"2"
+        assert base.get("a/k") == b"1"
+
+
+class TestTCPStore:
+    def test_basic(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0)
+        try:
+            _exercise(master)
+        finally:
+            master.close()
+
+    def test_client_server(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0)
+        try:
+            client = TCPStore("127.0.0.1", master.port, is_master=False, timeout=3.0)
+            master.set("from-master", b"m")
+            assert client.get("from-master") == b"m"
+            client.set("from-client", b"c")
+            assert master.get("from-client") == b"c"
+            assert client.add("ctr", 7) == 7
+            assert master.add("ctr", 1) == 8
+            client.close()
+        finally:
+            master.close()
+
+    def test_barrier(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0)
+        try:
+            clients = [
+                TCPStore("127.0.0.1", master.port, timeout=3.0) for _ in range(3)
+            ]
+            done = []
+
+            def arrive(s, i):
+                s.barrier(4, tag="t1")
+                done.append(i)
+
+            threads = [
+                threading.Thread(target=arrive, args=(s, i))
+                for i, s in enumerate(clients)
+            ]
+            for t in threads:
+                t.start()
+            master.barrier(4, tag="t1")
+            for t in threads:
+                t.join(3.0)
+            assert sorted(done) == [0, 1, 2]
+            for c in clients:
+                c.close()
+        finally:
+            master.close()
+
+    def test_cross_process(self, tmp_path):
+        """Real second process connects to the in-process daemon —
+        MultiProcessTestCase analog (SURVEY.md §4.1)."""
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+        try:
+            code = (
+                "import sys;"
+                "sys.path.insert(0, %r);"
+                "from pytorch_distributed_example_tpu.store import TCPStore;"
+                "s = TCPStore('127.0.0.1', %d, timeout=5.0);"
+                "s.set('child', b'hello');"
+                "print(s.get('parent').decode())"
+                % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), master.port)
+            )
+            master.set("parent", b"hi-child")
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=30,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            assert out.returncode == 0, out.stderr
+            assert "hi-child" in out.stdout
+            assert master.get("child") == b"hello"
+        finally:
+            master.close()
+
+
+class TestRendezvous:
+    def test_file_rendezvous(self, tmp_path):
+        from pytorch_distributed_example_tpu.rendezvous import rendezvous
+
+        url = f"file://{tmp_path}/rdzv?rank=0&world_size=2"
+        store, rank, world = next(iter(rendezvous(url)))
+        assert (rank, world) == (0, 2)
+        store.set("x", b"1")
+        assert store.get("x") == b"1"
+
+    def test_env_rendezvous(self, monkeypatch):
+        from pytorch_distributed_example_tpu.rendezvous import rendezvous
+
+        monkeypatch.setenv("RANK", "0")
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "0")
+        store, rank, world = next(iter(rendezvous("env://")))
+        assert (rank, world) == (0, 1)
+        store.set("y", b"2")
+        assert store.get("y") == b"2"
+        store.close()
+
+    def test_tcp_rendezvous(self):
+        from pytorch_distributed_example_tpu.rendezvous import rendezvous
+
+        store, rank, world = next(
+            iter(rendezvous("tcp://127.0.0.1:0?rank=0&world_size=1"))
+        )
+        assert (rank, world) == (0, 1)
+        store.set("z", b"3")
+        assert store.get("z") == b"3"
+        store.close()
+
+    def test_unknown_scheme(self):
+        from pytorch_distributed_example_tpu.rendezvous import (
+            RendezvousError,
+            rendezvous,
+        )
+
+        with pytest.raises(RendezvousError):
+            next(iter(rendezvous("bogus://x")))
