@@ -63,5 +63,8 @@ from .distributed import (  # noqa: F401
 )
 from .data.sampler import DistributedSampler  # noqa: F401
 from .parallel.ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401
+from .parallel.join import Join, Joinable  # noqa: F401
+from .parallel.reducer import Reducer  # noqa: F401
+from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
 
 __version__ = "0.1.0"

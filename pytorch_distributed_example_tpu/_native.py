@@ -1,0 +1,104 @@
+"""ctypes loader for the native C++ core (csrc/libtdx.so).
+
+Plays the role of torch's pybind11 surface (`_C/_distributed_c10d.pyi`,
+SURVEY.md §2.2 N18) with ctypes instead of pybind11 (not available in this
+environment — task rules). The library is built on demand with `make`; if
+the toolchain is missing, callers fall back to the pure-Python
+implementations (store.py, reducer.py) transparently.
+
+Env: TDX_NATIVE=0 disables native entirely (forces Python fallbacks).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_SO = os.path.join(_CSRC, "libtdx.so")
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    if os.environ.get("TDX_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _CSRC],
+                    capture_output=True,
+                    timeout=120,
+                    check=True,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # signatures
+        lib.tdx_store_server_start.restype = ctypes.c_void_p
+        lib.tdx_store_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tdx_store_server_port.restype = ctypes.c_int
+        lib.tdx_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.tdx_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tdx_store_client_connect.restype = ctypes.c_void_p
+        lib.tdx_store_client_connect.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_double,
+        ]
+        lib.tdx_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.tdx_store_client_call.restype = ctypes.c_long
+        lib.tdx_store_client_call.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_char_p,
+            ctypes.c_long,
+        ]
+        lib.tdx_store_client_response.restype = ctypes.POINTER(ctypes.c_char)
+        lib.tdx_store_client_response.argtypes = [ctypes.c_void_p]
+        lib.tdx_compute_buckets.restype = ctypes.c_long
+        lib.tdx_compute_buckets.argtypes = [
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def compute_buckets(sizes, cap_bytes: float, first_cap_bytes: float):
+    """Native bucket planner; returns list of buckets (lists of indices),
+    or None if the native lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(sizes)
+    arr = (ctypes.c_long * n)(*[int(s) for s in sizes])
+    out = (ctypes.c_long * n)()
+    nb = lib.tdx_compute_buckets(arr, n, cap_bytes, first_cap_bytes, out)
+    buckets = [[] for _ in range(nb)]
+    for i in range(n):
+        buckets[out[i]].append(i)
+    return buckets

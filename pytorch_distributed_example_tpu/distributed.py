@@ -83,6 +83,72 @@ class ProcessGroup:
         self.group_name = name
         self.timeout = timeout
         self.bound_device_id = None
+        from .utils.logger import ProcessGroupStatus
+
+        self.status = ProcessGroupStatus()
+        self.watchdog = None  # set by enable_watchdog()
+        self._inflight: List = []  # (work, done_cb) pending completion sweep
+
+    def enable_watchdog(self, timeout_s: Optional[float] = None, **kw):
+        """Start a hang watchdog over this group's in-flight collectives
+        (torch NCCL Watchdog parity — SURVEY.md §5.3)."""
+        from .utils.watchdog import Watchdog
+
+        self.watchdog = Watchdog(
+            timeout_s=timeout_s if timeout_s is not None else self.timeout, **kw
+        ).start()
+        return self.watchdog
+
+    def _sweep_inflight(self) -> None:
+        """Mark completion for sync-path works whose buffers became ready
+        (the sync path never calls wait(), so completion is observed here
+        and by any later wait())."""
+        still = []
+        for work, done in self._inflight:
+            if work.is_completed():
+                done()
+            else:
+                still.append((work, done))
+        self._inflight = still
+
+    def _dispatch(self, op_name: str, array, fn):
+        """Run one collective with full observability: sequence number,
+        ProcessGroupStatus, FlightRecorder entry, watchdog registration,
+        completion sweep."""
+        from .utils.flight_recorder import global_recorder
+
+        self._sweep_inflight()
+        seq = self._backend.next_sequence_number()
+        shape = tuple(getattr(array, "shape", ()))
+        numel = 1
+        for s in shape:
+            numel *= int(s)
+        dtype = getattr(array, "dtype", "")
+        self.status.record_enqueue(seq, op_name, numel)
+        rec = global_recorder()
+        rec.record(seq, op_name, self.group_name, shape, dtype, numel)
+        out, work = fn()
+        if self.watchdog is not None:
+            self.watchdog.register(work, f"{self.group_name}:{op_name}:{seq}")
+
+        fired = []
+
+        def _done(seq=seq, op=op_name, numel=numel, fired=fired):
+            if fired:
+                return
+            fired.append(True)
+            rec.complete(seq, self.group_name)
+            self.status.record_complete(seq, op, numel)
+
+        if hasattr(work, "_on_complete") and work._on_complete is None:
+            work._on_complete = _done
+            self._inflight.append((work, _done))
+            if len(self._inflight) > 512:  # bound bookkeeping + buffer pins
+                w0, d0 = self._inflight.pop(0)
+                w0.wait()
+        else:
+            _done()
+        return out, work
 
     # -- identity ----------------------------------------------------------
     def size(self) -> int:
@@ -412,8 +478,7 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool =
     DistTensor; lowers to `lax.psum`/`pmean`/... over the group mesh."""
     g = _resolve(group)
     dt = _as_dist(tensor, g)
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.allreduce(dt.array, op)
+    out, work = g._dispatch("all_reduce", dt.array, lambda: g.backend_impl.allreduce(dt.array, op))
     return _finish(dt, out, work, async_op)
 
 
@@ -422,8 +487,7 @@ def broadcast(tensor, src: int, group=None, async_op: bool = False):
     g = _resolve(group)
     g._check_member(src)
     dt = _as_dist(tensor, g)
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.broadcast(dt.array, src)
+    out, work = g._dispatch("broadcast", dt.array, lambda: g.backend_impl.broadcast(dt.array, src))
     return _finish(dt, out, work, async_op)
 
 
@@ -433,8 +497,7 @@ def reduce(tensor, dst: int, op: ReduceOp = ReduceOp.SUM, group=None, async_op: 
     g = _resolve(group)
     g._check_member(dst)
     dt = _as_dist(tensor, g)
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.reduce(dt.array, dst, op)
+    out, work = g._dispatch("reduce", dt.array, lambda: g.backend_impl.reduce(dt.array, dst, op))
     return _finish(dt, out, work, async_op)
 
 
@@ -444,8 +507,7 @@ def all_gather(tensor, group=None, async_op: bool = False) -> Union[DistTensor, 
     (the rank axis replaces torch's output tensor list)."""
     g = _resolve(group)
     dt = _as_dist(tensor, g)
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.allgather(dt.array)
+    out, work = g._dispatch("all_gather", dt.array, lambda: g.backend_impl.allgather(dt.array))
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -456,8 +518,7 @@ def gather(tensor, dst: int = 0, group=None, async_op: bool = False):
     g = _resolve(group)
     g._check_member(dst)
     dt = _as_dist(tensor, g)
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.gather(dt.array, dst)
+    out, work = g._dispatch("gather", dt.array, lambda: g.backend_impl.gather(dt.array, dst))
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -473,8 +534,7 @@ def scatter(tensor, src: int = 0, group=None, async_op: bool = False):
         raise ValueError(
             f"scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
         )
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.scatter(dt.array, src)
+    out, work = g._dispatch("scatter", dt.array, lambda: g.backend_impl.scatter(dt.array, src))
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -489,8 +549,7 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bo
         raise ValueError(
             f"reduce_scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
         )
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.reduce_scatter(dt.array, op)
+    out, work = g._dispatch("reduce_scatter", dt.array, lambda: g.backend_impl.reduce_scatter(dt.array, op))
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -505,8 +564,7 @@ def all_to_all(tensor, group=None, async_op: bool = False):
         raise ValueError(
             f"all_to_all input per-rank leading dim {dt.shape[0]} != world {g.size()}"
         )
-    g.backend_impl.next_sequence_number()
-    out, work = g.backend_impl.alltoall(dt.array)
+    out, work = g._dispatch("all_to_all", dt.array, lambda: g.backend_impl.alltoall(dt.array))
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -514,8 +572,7 @@ def all_to_all(tensor, group=None, async_op: bool = False):
 def barrier(group=None, async_op: bool = False, device_ids=None):
     """torch `barrier` (`distributed_c10d.py:5284`)."""
     g = _resolve(group)
-    g.backend_impl.next_sequence_number()
-    work = g.backend_impl.barrier()
+    _, work = g._dispatch("barrier", None, lambda: (None, g.backend_impl.barrier()))
     return work if async_op else None
 
 
@@ -606,7 +663,11 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Work]:
     for _, entries in by_tensor.items():
         perm = [p for p, _, _ in entries]
         src_dt = entries[0][1].tensor
-        out, work = g.backend_impl.permute(src_dt.array, perm)
+        out, work = g._dispatch(
+            "batch_isend_irecv",
+            src_dt.array,
+            lambda src_dt=src_dt, perm=perm: g.backend_impl.permute(src_dt.array, perm),
+        )
         for _, s, r in entries:
             r.tensor._set(out)
         works.append(work)
@@ -620,7 +681,9 @@ def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = Non
     if src is None:
         raise ValueError("driver mode: send(...) needs src= (acting rank)")
     dt = _as_dist(tensor, g)
-    out, work = g.backend_impl.permute(dt.array, [(src, dst)])
+    out, work = g._dispatch(
+        "send", dt.array, lambda: g.backend_impl.permute(dt.array, [(src, dst)])
+    )
     dt._set(out)
     return None
 
@@ -637,7 +700,9 @@ def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = No
     if src is None:
         raise ValueError("driver mode: isend(...) needs src= (acting rank)")
     dt = _as_dist(tensor, g)
-    out, work = g.backend_impl.permute(dt.array, [(src, dst)])
+    out, work = g._dispatch(
+        "isend", dt.array, lambda: g.backend_impl.permute(dt.array, [(src, dst)])
+    )
     dt._set(out)
     return work
 

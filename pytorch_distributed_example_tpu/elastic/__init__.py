@@ -1,0 +1,1 @@
+from .agent import LocalElasticAgent, WorkerSpec, WorkerState  # noqa: F401

@@ -1,0 +1,181 @@
+"""Elastic agent: spawn, monitor, and restart a gang of workers.
+
+Parity surface (SURVEY.md §1-L7, §2.1 P8): torchelastic's
+`SimpleElasticAgent` (`elastic/agent/server/api.py:455`) — worker spawn,
+`_monitor_workers` poll loop (`:499,:924`), gang restart on failure up to
+`max_restarts` (`:952-970`, default 3 `:96`), and `LocalElasticAgent`
+(`local_elastic_agent.py:118`) which runs workers as local subprocesses.
+
+Per-worker env (the contract the reference's env:// rendezvous reads,
+torch `rendezvous.py:258-274`): RANK, LOCAL_RANK, WORLD_SIZE, MASTER_ADDR,
+MASTER_PORT, plus TDX_RESTART_COUNT / TORCHELASTIC_RESTART_COUNT.
+
+The agent hosts the rendezvous TCPStore (native C++ daemon when built) and
+re-keys it per restart generation so re-rendezvous is clean.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..store import TCPStore
+
+
+class WorkerState(enum.Enum):
+    INIT = "INIT"
+    HEALTHY = "HEALTHY"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+
+@dataclass
+class WorkerSpec:
+    """What to run — torchelastic WorkerSpec equivalent."""
+
+    entrypoint: Sequence[str]  # argv after `python`, or full argv if raw_cmd
+    nproc_per_node: int = 1
+    max_restarts: int = 3  # torchelastic default (api.py:96)
+    monitor_interval_s: float = 0.1
+    master_addr: str = "127.0.0.1"
+    master_port: int = 0  # 0 = pick free port
+    raw_cmd: bool = False  # entrypoint is a full argv, not a python script
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class _Worker:
+    local_rank: int
+    proc: Optional[subprocess.Popen] = None
+    state: WorkerState = WorkerState.INIT
+
+
+@dataclass
+class RunResult:
+    state: WorkerState
+    restarts: int
+    return_codes: Dict[int, int]
+
+
+class LocalElasticAgent:
+    def __init__(self, spec: WorkerSpec, log_dir: Optional[str] = None):
+        self.spec = spec
+        self.log_dir = log_dir
+        self._store: Optional[TCPStore] = None
+        self._workers: List[_Worker] = []
+        self.restart_count = 0
+
+    # -- store hosting -----------------------------------------------------
+    def _ensure_store(self) -> TCPStore:
+        if self._store is None:
+            self._store = TCPStore(
+                self.spec.master_addr,
+                self.spec.master_port,
+                world_size=self.spec.nproc_per_node,
+                is_master=True,
+                timeout=300.0,
+            )
+        return self._store
+
+    # -- spawn -------------------------------------------------------------
+    def _start_workers(self) -> None:
+        store = self._ensure_store()
+        self._workers = []
+        for r in range(self.spec.nproc_per_node):
+            env = {
+                **os.environ,
+                **self.spec.env,
+                "RANK": str(r),
+                "LOCAL_RANK": str(r),
+                "WORLD_SIZE": str(self.spec.nproc_per_node),
+                "MASTER_ADDR": self.spec.master_addr,
+                "MASTER_PORT": str(store.port),
+                "TDX_RESTART_COUNT": str(self.restart_count),
+                "TORCHELASTIC_RESTART_COUNT": str(self.restart_count),
+                "TDX_AGENT_STORE": f"{self.spec.master_addr}:{store.port}",
+                # env:// rendezvous must CONNECT to the agent's store, not
+                # bind MASTER_PORT itself (torchelastic's
+                # TORCHELASTIC_USE_AGENT_STORE contract)
+                "TDX_USE_AGENT_STORE": "1",
+                "TORCHELASTIC_USE_AGENT_STORE": "True",
+            }
+            argv = (
+                list(self.spec.entrypoint)
+                if self.spec.raw_cmd
+                else [sys.executable] + list(self.spec.entrypoint)
+            )
+            stdout = stderr = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(
+                    os.path.join(
+                        self.log_dir, f"worker_{r}_attempt{self.restart_count}.log"
+                    ),
+                    "w",
+                )
+                stderr = subprocess.STDOUT
+            proc = subprocess.Popen(argv, env=env, stdout=stdout, stderr=stderr)
+            self._workers.append(_Worker(r, proc, WorkerState.HEALTHY))
+
+    def _stop_workers(self) -> None:
+        for w in self._workers:
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        deadline = time.monotonic() + 5
+        for w in self._workers:
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait(5)
+
+    # -- monitor (api.py:499) ---------------------------------------------
+    def _monitor(self) -> WorkerState:
+        while True:
+            time.sleep(self.spec.monitor_interval_s)
+            codes = {w.local_rank: w.proc.poll() for w in self._workers}
+            if any(c is not None and c != 0 for c in codes.values()):
+                return WorkerState.FAILED
+            if all(c == 0 for c in codes.values()):
+                return WorkerState.SUCCEEDED
+
+    # -- run with restarts (api.py:952-970) -------------------------------
+    def run(self) -> RunResult:
+        try:
+            self._start_workers()
+            while True:
+                state = self._monitor()
+                if state is WorkerState.SUCCEEDED:
+                    return RunResult(
+                        state,
+                        self.restart_count,
+                        {w.local_rank: w.proc.returncode for w in self._workers},
+                    )
+                # failure: tear down the whole gang and re-rendezvous
+                self._stop_workers()
+                if self.restart_count >= self.spec.max_restarts:
+                    return RunResult(
+                        WorkerState.FAILED,
+                        self.restart_count,
+                        {w.local_rank: w.proc.returncode for w in self._workers},
+                    )
+                self.restart_count += 1
+                # fresh store per generation: stale barrier/worker-count keys
+                # from the failed generation must not leak into the new one
+                if self._store is not None:
+                    self._store.close()
+                    self._store = None
+                self._start_workers()
+        finally:
+            self._stop_workers()
+            if self._store is not None:
+                self._store.close()
+                self._store = None

@@ -1,0 +1,65 @@
+"""tpurun — the launcher CLI (torchrun equivalent).
+
+Parity surface: `torch/distributed/run.py:410,985` (SURVEY.md §1-L7):
+spawn `--nproc-per-node` workers with rendezvous env, monitor, restart up
+to `--max-restarts`.
+
+Usage:
+    python -m pytorch_distributed_example_tpu.elastic.run \
+        --nproc-per-node 2 --max-restarts 3 my_script.py --my-arg 1
+
+Note the TPU-native stance: on a single host the idiomatic deployment is
+ONE driver process owning all chips (driver mode) — `tpurun` exists for
+multi-process deployments (one process per host on a pod, CPU-only CI
+gangs) and for parity with the reference's launch recipe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .agent import LocalElasticAgent, WorkerSpec, WorkerState
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="tpurun")
+    p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--monitor-interval", type=float, default=0.1)
+    p.add_argument("--master-addr", type=str, default="127.0.0.1")
+    p.add_argument("--master-port", type=int, default=0)
+    p.add_argument("--log-dir", type=str, default=None)
+    p.add_argument("--no-python", action="store_true",
+                   help="entrypoint is a raw command, not a python script")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if not args.entrypoint:
+        print("tpurun: missing entrypoint script", file=sys.stderr)
+        return 2
+    spec = WorkerSpec(
+        entrypoint=args.entrypoint,
+        nproc_per_node=args.nproc_per_node,
+        max_restarts=args.max_restarts,
+        monitor_interval_s=args.monitor_interval,
+        master_addr=args.master_addr,
+        master_port=args.master_port,
+        raw_cmd=args.no_python,
+    )
+    result = LocalElasticAgent(spec, log_dir=args.log_dir).run()
+    if result.state is WorkerState.SUCCEEDED:
+        return 0
+    print(
+        f"tpurun: workers failed after {result.restarts} restart(s): "
+        f"{result.return_codes}",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

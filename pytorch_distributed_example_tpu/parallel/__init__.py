@@ -1,2 +1,4 @@
-from .ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401
+from .ddp import DistributedDataParallel, make_ddp_train_step, make_eval_step  # noqa: F401
+from .reducer import Reducer, compute_bucket_assignment_by_size  # noqa: F401
+from .join import Join, Joinable, JoinHook, join_batches  # noqa: F401
 from . import comm_hooks  # noqa: F401

@@ -1,0 +1,133 @@
+"""Join — uneven-input handling across ranks.
+
+Parity surface: `torch/distributed/algorithms/join.py` (`Joinable` `:44`,
+`Join` `:104`) + DDP's `join()` / `_DDPJoinHook`
+(`nn/parallel/distributed.py:1989,:412`) — SURVEY.md §2.1 P7: when ranks
+have unequal numbers of input batches, ranks that exhaust data early must
+"shadow" the collectives of still-training ranks (contributing zero
+gradients) so nobody deadlocks.
+
+TPU-native form: in driver (SPMD) mode every step is ONE program over all
+ranks, so a deadlock is impossible by construction — the uneven-input
+problem becomes a *masking* problem: exhausted ranks must contribute zero
+to the gradient mean and not skew the divisor. `join_batches` implements
+exactly that: it pads per-rank streams to the longest stream and emits a
+per-sample weight mask; a weighted loss (`weighted_loss_fn`) then
+reproduces torch-Join numerics inside the compiled step. The `Join` /
+`Joinable` classes keep the torch API shape for code being ported.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class JoinHook:
+    """Per-joinable shadow hooks — torch JoinHook."""
+
+    def main_hook(self) -> None: ...
+
+    def post_hook(self, is_last_joiner: bool) -> None: ...
+
+
+class Joinable:
+    """torch `Joinable` (join.py:44) protocol."""
+
+    def join_hook(self, **kwargs) -> JoinHook:
+        return JoinHook()
+
+    @property
+    def join_device(self):
+        return None
+
+    @property
+    def join_process_group(self):
+        from .. import distributed as dist
+
+        return dist._get_default_group()
+
+
+class Join(contextlib.AbstractContextManager):
+    """torch `Join` (join.py:104) context manager.
+
+    In driver mode all ranks advance in lockstep inside one process, so
+    there is nothing to shadow; the context validates its joinables and
+    runs their post-hooks on exit (API parity for ported code)."""
+
+    def __init__(self, joinables: Sequence[Joinable], enable: bool = True, **kwargs):
+        if not joinables:
+            raise ValueError("Join expects at least one Joinable")
+        self.joinables = list(joinables)
+        self.enable = enable
+        self._hooks: List[JoinHook] = []
+
+    def __enter__(self):
+        if self.enable:
+            self._hooks = [j.join_hook() for j in self.joinables]
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.enable and exc_type is None:
+            for i, h in enumerate(self._hooks):
+                h.post_hook(is_last_joiner=(i == len(self._hooks) - 1))
+        return False
+
+    @staticmethod
+    def notify_join_context(joinable: Joinable) -> None:
+        """torch `Join.notify_join_context` — first-joinable per-iteration
+        notification; a no-op under lockstep SPMD."""
+        return None
+
+
+def join_batches(
+    per_rank_batches: Sequence[Sequence[Tuple[np.ndarray, np.ndarray]]],
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Pad uneven per-rank batch streams into global (x, y, weight) steps.
+
+    `per_rank_batches[r]` is rank r's list of (x, y) microbatches (as from
+    a per-rank DataLoader). Streams shorter than the longest are padded
+    with zero-weighted repeats of their last batch — the exhausted rank
+    "joins" and shadows remaining steps with zero contribution, exactly
+    torch-Join's effect on the gradient allreduce.
+    """
+    world = len(per_rank_batches)
+    streams = [list(s) for s in per_rank_batches]
+    if any(len(s) == 0 for s in streams):
+        raise ValueError("every rank needs at least one batch to define shapes")
+    longest = max(len(s) for s in streams)
+    for step in range(longest):
+        xs, ys, ws = [], [], []
+        for r in range(world):
+            s = streams[r]
+            if step < len(s):
+                x, y = s[step]
+                w = np.ones((x.shape[0],), np.float32)
+            else:
+                x, y = s[-1]  # shadow batch: shapes right, weight zero
+                w = np.zeros((x.shape[0],), np.float32)
+            xs.append(x)
+            ys.append(y)
+            ws.append(w)
+        yield np.concatenate(xs), np.concatenate(ys), np.concatenate(ws)
+
+
+def weighted_loss_fn(loss_fn):
+    """Lift `loss_fn(logits, y) -> per-sample losses` into a join-aware
+    weighted mean: `(logits, y, w) -> sum(l*w)/psum-safe local mean`.
+
+    Use with `make_ddp_train_step`-style steps where the global divisor
+    must count only real samples: the local value is sum(l*w)/sum_global(w)
+    via the lax.psum of weights performed by the caller's pmean — in
+    practice pair this with `join_batches` whose weights are balanced per
+    step, so a plain weighted mean is exact."""
+
+    def fn(logits, y, w):
+        import jax.numpy as jnp
+
+        losses = loss_fn(logits, y)
+        return (losses * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+    return fn

@@ -45,6 +45,12 @@ def compute_bucket_assignment_by_size(
     `_compute_bucket_assignment_by_size` (bound in reducer.hpp, SURVEY.md
     N6). The first bucket gets a smaller cap so the first allreduce launches
     early in backward."""
+    from .. import _native
+
+    native = _native.compute_buckets(sizes_bytes, bucket_cap_bytes, first_bucket_bytes)
+    if native is not None:
+        return native
+
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_bytes = 0.0
@@ -162,10 +168,19 @@ class Reducer:
             flat = jnp.concatenate(
                 [leaves[i].reshape(W, -1) for i in idx_list], axis=1
             )
+            bucket_no = len(in_flight)
             if self.comm_hook is not None:
-                out, work = self.comm_hook(backend, flat)
+                out, work = self.group._dispatch(
+                    f"reduce_bucket[{bucket_no}]",
+                    flat,
+                    lambda flat=flat: self.comm_hook(backend, flat),
+                )
             else:
-                out, work = backend.allreduce(flat, ReduceOp.AVG)
+                out, work = self.group._dispatch(
+                    f"reduce_bucket[{bucket_no}]",
+                    flat,
+                    lambda flat=flat: backend.allreduce(flat, ReduceOp.AVG),
+                )
             in_flight.append(
                 Bucket(idx_list, offsets, lengths, shapes, sum(lengths), work, out)
             )

@@ -73,7 +73,14 @@ def _env_handler(url: str, rank: int, world_size: int, timeout: float = 300.0, *
     world_size = int(env("WORLD_SIZE", world_ if world_ >= 1 else None))
     host = env("MASTER_ADDR", "127.0.0.1")
     port = int(env("MASTER_PORT", DEFAULT_PORT))
-    store = TCPStore(host, port, world_size, is_master=(rank == 0), timeout=timeout)
+    # under an elastic agent the store already exists at MASTER_PORT —
+    # everyone (rank 0 included) connects as a client
+    # (torchelastic TORCHELASTIC_USE_AGENT_STORE contract)
+    use_agent_store = os.environ.get("TDX_USE_AGENT_STORE") == "1" or (
+        os.environ.get("TORCHELASTIC_USE_AGENT_STORE", "").lower() == "true"
+    )
+    is_master = rank == 0 and not use_agent_store
+    store = TCPStore(host, port, world_size, is_master=is_master, timeout=timeout)
     yield (store, rank, world_size)
 
 

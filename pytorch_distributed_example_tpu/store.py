@@ -430,7 +430,12 @@ class _TCPStoreDaemon(threading.Thread):
 
 class TCPStore(Store):
     """Client/server TCP KV store — torch TCPStore.hpp. `is_master=True`
-    (rank 0) hosts the daemon in-process; everyone connects as a client."""
+    (rank 0) hosts the daemon in-process; everyone connects as a client.
+
+    Uses the native C++ epoll daemon/client (csrc/store.cpp via ctypes)
+    when available — same wire protocol, so native and Python peers mix
+    freely; falls back to the threaded Python implementation otherwise
+    (TDX_NATIVE=0 forces the fallback)."""
 
     def __init__(
         self,
@@ -440,17 +445,42 @@ class TCPStore(Store):
         is_master: bool = False,
         timeout: float = _DEFAULT_TIMEOUT,
         wait_for_workers: bool = False,
+        use_native: Optional[bool] = None,
     ):
         super().__init__(timeout)
+        from . import _native
+
         self.host = host
         self.world_size = world_size
         self._daemon: Optional[_TCPStoreDaemon] = None
+        self._native_daemon = None
+        self._native_client = None
+        self._lib = _native.load() if use_native in (None, True) else None
+        self.native = self._lib is not None
         if is_master:
-            self._daemon = _TCPStoreDaemon(host, port)
-            self._daemon.start()
-            port = self._daemon.port
+            if self.native:
+                self._native_daemon = self._lib.tdx_store_server_start(
+                    host.encode(), port
+                )
+                if not self._native_daemon:
+                    raise OSError(f"native store daemon failed to bind {host}:{port}")
+                port = self._lib.tdx_store_server_port(self._native_daemon)
+            else:
+                self._daemon = _TCPStoreDaemon(host, port)
+                self._daemon.start()
+                port = self._daemon.port
         self.port = port
-        self._sock = self._connect()
+        if self.native:
+            self._native_client = self._lib.tdx_store_client_connect(
+                host.encode(), port, float(timeout)
+            )
+            if not self._native_client:
+                raise StoreTimeoutError(
+                    f"could not connect to store at {host}:{port}"
+                )
+            self._sock = None
+        else:
+            self._sock = self._connect()
         self._sock_lock = threading.Lock()
         # worker-join handshake (torch TCPStore wait_for_workers semantics):
         # every worker registers on connect; the master's constructor blocks
@@ -481,6 +511,18 @@ class TCPStore(Store):
 
     def _call(self, cmd: int, key: str, val: bytes) -> bytes:
         kb = key.encode()
+        if self.native:
+            with self._sock_lock:
+                n = self._lib.tdx_store_client_call(
+                    self._native_client, cmd, kb, len(kb), val, len(val)
+                )
+                if n < 0:
+                    raise ConnectionError("native store call failed")
+                import ctypes
+
+                return ctypes.string_at(
+                    self._lib.tdx_store_client_response(self._native_client), n
+                )
         msg = bytes([cmd]) + struct.pack("<I", len(kb)) + kb + struct.pack("<I", len(val)) + val
         with self._sock_lock:
             self._sock.sendall(msg)
@@ -520,11 +562,18 @@ class TCPStore(Store):
 
     def close(self):
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
+            if self._native_client is not None:
+                self._lib.tdx_store_client_close(self._native_client)
+                self._native_client = None
         finally:
             if self._daemon is not None:
                 self._daemon.stop()
+            if self._native_daemon is not None:
+                self._lib.tdx_store_server_stop(self._native_daemon)
+                self._native_daemon = None
 
     @property
     def is_master(self) -> bool:
-        return self._daemon is not None
+        return self._daemon is not None or self._native_daemon is not None

@@ -1,0 +1,8 @@
+from .logger import (  # noqa: F401
+    DDPLogger,
+    ProcessGroupStatus,
+    exception_logger,
+    time_logger,
+)
+from .flight_recorder import FlightRecorder, DebugInfoWriter  # noqa: F401
+from .watchdog import Watchdog, HeartbeatMonitor  # noqa: F401

@@ -1,0 +1,126 @@
+"""Observability: DDP logging data, per-group status, API decorators.
+
+Parity surface (SURVEY.md §2.2 N14, §5.5):
+  - `DDPLogger` ≈ torch's DDP `Logger` + `DDPLoggingData`
+    (`logger.hpp:42-90`; `_get_ddp_logging_data`,
+    `nn/parallel/distributed.py:2552`): construction-time facts (world
+    size, bucket layout) + runtime stats (avg step/comm times, rebuilds).
+  - `ProcessGroupStatus` ≈ torch `ProcessGroupStatus` (`logger.hpp:12-40`):
+    last enqueued/started/completed collective.
+  - `exception_logger` / `time_logger` ≈ torch `c10d_logger.py:79,93`
+    decorators wrapping every public collective.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("tdx.distributed")
+
+
+@dataclass
+class ProcessGroupStatus:
+    """Last-collective bookkeeping — torch logger.hpp:12-40."""
+
+    last_enqueued_seq: int = -1
+    last_enqueued_op: str = ""
+    last_enqueued_numel: int = 0
+    last_started_seq: int = -1
+    last_started_op: str = ""
+    last_completed_seq: int = -1
+    last_completed_op: str = ""
+    last_completed_numel: int = 0
+
+    def record_enqueue(self, seq: int, op: str, numel: int) -> None:
+        self.last_enqueued_seq = seq
+        self.last_enqueued_op = op
+        self.last_enqueued_numel = numel
+        # XLA dispatch starts execution immediately (async): enqueue==start
+        self.last_started_seq = seq
+        self.last_started_op = op
+
+    def record_complete(self, seq: int, op: str, numel: int) -> None:
+        self.last_completed_seq = seq
+        self.last_completed_op = op
+        self.last_completed_numel = numel
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+class DDPLogger:
+    """Runtime stats for a DDP instance — torch Logger/DDPLoggingData."""
+
+    def __init__(self, ddp) -> None:
+        self._ddp = ddp
+        self.step_times: list = []
+        self._step_start: Optional[float] = None
+
+    def step_begin(self) -> None:
+        self._step_start = time.perf_counter()
+
+    def step_end(self) -> None:
+        if self._step_start is not None:
+            self.step_times.append(time.perf_counter() - self._step_start)
+            self._step_start = None
+
+    def get_ddp_logging_data(self) -> Dict[str, Any]:
+        g = self._ddp.process_group
+        red = self._ddp.reducer
+        times = self.step_times[-100:]
+        return {
+            "world_size": g.size(),
+            "rank": g.rank(),
+            "backend_name": g.backend_name,
+            "bucket_cap_bytes": int(red.bucket_cap_bytes),
+            "first_bucket_bytes": int(red.first_bucket_bytes),
+            "num_buckets": red.stats["num_buckets"],
+            "bucket_sizes": list(red.stats["bucket_sizes"]),
+            "rebuilds": red.stats["rebuilds"],
+            "reduce_calls": red.stats["reduce_calls"],
+            "avg_step_time_s": (sum(times) / len(times)) if times else 0.0,
+            "num_steps": len(self.step_times),
+            "find_unused_parameters": self._ddp.find_unused_parameters,
+        }
+
+
+def exception_logger(fn):
+    """Log-and-reraise wrapper — torch `_exception_logger`
+    (c10d_logger.py:79). Failures are logged with group context so a crash
+    in rank N's collective is attributable from its log alone."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            from .. import distributed as dist
+
+            rank = dist.get_rank() if dist.is_initialized() else -1
+            logger.exception("[rank%s] %s failed", rank, fn.__name__)
+            raise
+
+    return wrapper
+
+
+def time_logger(fn):
+    """Debug-level timing wrapper — torch `_time_logger`
+    (c10d_logger.py:93)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not logger.isEnabledFor(logging.DEBUG):
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            logger.debug(
+                "%s took %.3f ms", fn.__name__, (time.perf_counter() - t0) * 1e3
+            )
+
+    return wrapper

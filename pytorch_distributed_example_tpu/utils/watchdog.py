@@ -1,0 +1,147 @@
+"""Watchdog + HeartbeatMonitor — hang detection for outstanding collectives.
+
+Parity surface (SURVEY.md §2.2 N10, §5.3): torch ProcessGroupNCCL's
+`Watchdog` thread scanning `workMetaList_` for timed-out work
+(`ProcessGroupNCCL.hpp:676,701,1387`) with flight-recorder dump on timeout
+(`TORCH_NCCL_DUMP_ON_TIMEOUT`), and the `HeartbeatMonitor` that kills the
+process if the watchdog itself wedges (`:596-608`,
+`TORCH_NCCL_HEARTBEAT_TIMEOUT_SEC`).
+
+TPU mapping: outstanding work = dispatched-but-unready XLA executions
+(`ArrayWork`s). A hung ICI collective (e.g. a peer rank never joining in
+multiproc mode) leaves its Work unready past the group timeout; the
+watchdog then dumps the flight recorder and invokes the abort callback.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from typing import Callable, List, Optional, Tuple
+
+from .flight_recorder import DebugInfoWriter, FlightRecorder, global_recorder
+
+
+class Watchdog:
+    """Background scanner over registered in-flight Works."""
+
+    def __init__(
+        self,
+        timeout_s: float = 1800.0,
+        poll_interval_s: float = 1.0,
+        on_timeout: Optional[Callable] = None,
+        recorder: Optional[FlightRecorder] = None,
+        writer: Optional[DebugInfoWriter] = None,
+        dump_on_timeout: bool = True,
+    ):
+        self.timeout_s = timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.on_timeout = on_timeout
+        self.recorder = recorder or global_recorder()
+        self.writer = writer or DebugInfoWriter()
+        self.dump_on_timeout = dump_on_timeout
+        self._work: List[Tuple[float, str, object]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_heartbeat = time.monotonic()
+        self.tripped: Optional[str] = None
+
+    # -- registration ------------------------------------------------------
+    def register(self, work, desc: str = "") -> None:
+        # strong reference: the sync path discards its Work immediately, and
+        # a weakref would die before the first scan — completed entries are
+        # dropped every poll, so retention is bounded by the poll interval.
+        with self._lock:
+            self._work.append((time.monotonic(), desc, work))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tdx-watchdog"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.last_heartbeat = time.monotonic()
+            self._scan()
+
+    def _scan(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            alive = []
+            expired = []
+            for t0, desc, w in self._work:
+                if w.is_completed():
+                    continue
+                if now - t0 > self.timeout_s:
+                    expired.append((t0, desc, w))
+                else:
+                    alive.append((t0, desc, w))
+            self._work = alive
+        for t0, desc, w in expired:
+            self.tripped = desc
+            path = ""
+            if self.dump_on_timeout:
+                path = self.writer.write(
+                    self.recorder, reason=f"watchdog timeout: {desc}"
+                )
+            if self.on_timeout is not None:
+                self.on_timeout(desc, w, path)
+
+
+class HeartbeatMonitor:
+    """Aborts the process if the watchdog itself stops beating — torch
+    HeartbeatMonitor (`ProcessGroupNCCL.hpp:596`). Killing is opt-in
+    (`kill_process=True` ≈ TORCH_NCCL_HEARTBEAT_TIMEOUT_SEC behavior)."""
+
+    def __init__(
+        self,
+        watchdog: Watchdog,
+        heartbeat_timeout_s: float = 60.0,
+        kill_process: bool = False,
+        on_stuck: Optional[Callable] = None,
+    ):
+        self.watchdog = watchdog
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.kill_process = kill_process
+        self.on_stuck = on_stuck
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stuck = False
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tdx-heartbeat"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(min(self.heartbeat_timeout_s / 4, 5.0)):
+            age = time.monotonic() - self.watchdog.last_heartbeat
+            if age > self.heartbeat_timeout_s:
+                self.stuck = True
+                if self.on_stuck is not None:
+                    self.on_stuck(age)
+                if self.kill_process:
+                    os._exit(1)
+                return

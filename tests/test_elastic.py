@@ -1,0 +1,165 @@
+"""Elastic agent tests: gang spawn, env contract, restart-on-failure.
+
+Models torchelastic's agent behavior (SURVEY.md §5.3): monitor workers,
+restart the whole gang ≤ max_restarts with a fresh restart counter, give
+up past the budget. Workers are tiny pure-python scripts (no jax import)
+so the gang runs fast on one core.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pytorch_distributed_example_tpu.elastic import (
+    LocalElasticAgent,
+    WorkerSpec,
+    WorkerState,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestAgent:
+    def test_gang_success_and_env(self, tmp_path):
+        script = _write(
+            tmp_path,
+            "ok.py",
+            """
+            import os
+            out = os.environ["OUT_DIR"]
+            r = os.environ["RANK"]
+            with open(os.path.join(out, f"rank{r}.txt"), "w") as f:
+                f.write("|".join([
+                    os.environ["RANK"], os.environ["WORLD_SIZE"],
+                    os.environ["MASTER_ADDR"], os.environ["MASTER_PORT"],
+                    os.environ["TDX_RESTART_COUNT"],
+                ]))
+            """,
+        )
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=2,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        res = LocalElasticAgent(spec).run()
+        assert res.state is WorkerState.SUCCEEDED
+        assert res.restarts == 0
+        for r in range(2):
+            fields = (tmp_path / f"rank{r}.txt").read_text().split("|")
+            assert fields[0] == str(r)
+            assert fields[1] == "2"
+            assert int(fields[3]) > 0  # real store port
+            assert fields[4] == "0"
+
+    def test_restart_on_failure_then_success(self, tmp_path):
+        # rank 1 fails on attempt 0, succeeds on attempt 1 (flag file)
+        script = _write(
+            tmp_path,
+            "flaky.py",
+            """
+            import os, sys
+            out = os.environ["OUT_DIR"]
+            rank = os.environ["RANK"]
+            attempt = int(os.environ["TDX_RESTART_COUNT"])
+            if rank == "1" and attempt == 0:
+                sys.exit(3)
+            with open(os.path.join(out, f"done{rank}.txt"), "w") as f:
+                f.write(str(attempt))
+            """,
+        )
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=2,
+            max_restarts=2,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        res = LocalElasticAgent(spec).run()
+        assert res.state is WorkerState.SUCCEEDED
+        assert res.restarts == 1
+        assert (tmp_path / "done0.txt").read_text() == "1"
+        assert (tmp_path / "done1.txt").read_text() == "1"
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        script = _write(tmp_path, "bad.py", "import sys; sys.exit(7)\n")
+        spec = WorkerSpec(
+            entrypoint=[script], nproc_per_node=2, max_restarts=1
+        )
+        res = LocalElasticAgent(spec).run()
+        assert res.state is WorkerState.FAILED
+        assert res.restarts == 1
+        assert 7 in res.return_codes.values()
+
+    def test_workers_share_agent_store(self, tmp_path):
+        """Workers rendezvous through the agent-hosted TCPStore."""
+        script = _write(
+            tmp_path,
+            "store_user.py",
+            f"""
+            import os, sys
+            sys.path.insert(0, {REPO!r})
+            from pytorch_distributed_example_tpu.store import TCPStore
+            host, port = os.environ["TDX_AGENT_STORE"].rsplit(":", 1)
+            s = TCPStore(host, int(port), timeout=20.0)
+            rank = os.environ["RANK"]
+            s.set(f"hello/{{rank}}", rank.encode())
+            s.wait([f"hello/0", f"hello/1"], 20.0)
+            s.barrier(2, tag="t")
+            s.close()
+            """,
+        )
+        spec = WorkerSpec(entrypoint=[script], nproc_per_node=2)
+        res = LocalElasticAgent(spec).run()
+        assert res.state is WorkerState.SUCCEEDED
+
+
+class TestRunCLI:
+    def test_tpurun_end_to_end(self, tmp_path):
+        script = _write(
+            tmp_path,
+            "hello.py",
+            """
+            import os
+            print("rank", os.environ["RANK"], "of", os.environ["WORLD_SIZE"])
+            """,
+        )
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.elastic.run",
+                "--nproc-per-node",
+                "2",
+                script,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr
+
+    def test_tpurun_missing_entrypoint(self):
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.elastic.run",
+                "--nproc-per-node",
+                "1",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            cwd=REPO,
+        )
+        assert out.returncode == 2
+        assert "missing entrypoint" in out.stderr

@@ -1,0 +1,193 @@
+"""Join (uneven inputs) + checkpoint/resume tests."""
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+
+
+class TestJoin:
+    def test_join_batches_pads_and_masks(self):
+        from pytorch_distributed_example_tpu.parallel.join import join_batches
+
+        def mk(n, tag):
+            return [
+                (np.full((2, 3), 10 * tag + i, np.float32), np.full((2,), tag, np.int32))
+                for i in range(n)
+            ]
+
+        streams = [mk(3, 0), mk(1, 1)]  # rank 1 exhausts after 1 batch
+        steps = list(join_batches(streams))
+        assert len(steps) == 3
+        x, y, w = steps[0]
+        assert x.shape == (4, 3) and w.tolist() == [1, 1, 1, 1]
+        x, y, w = steps[2]
+        # rank 1 half is shadow: weight zero
+        assert w.tolist() == [1, 1, 0, 0]
+
+    def test_join_context_api(self, world):
+        from pytorch_distributed_example_tpu.parallel.join import Join, Joinable
+
+        class J(Joinable):
+            def __init__(self):
+                self.post = []
+
+            def join_hook(self, **kw):
+                from pytorch_distributed_example_tpu.parallel.join import JoinHook
+
+                outer = self
+
+                class H(JoinHook):
+                    def post_hook(self, is_last_joiner):
+                        outer.post.append(is_last_joiner)
+
+                return H()
+
+        j = J()
+        with Join([j]):
+            Join.notify_join_context(j)
+        assert j.post == [True]
+        with pytest.raises(ValueError):
+            Join([])
+
+    def test_weighted_training_ignores_shadow(self, world):
+        """A shadow (zero-weight) half-batch must not change gradients."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        ddp = tdx.DistributedDataParallel(model, params)
+        opt = optax.sgd(0.1)
+        W = world.size()
+
+        def wloss(logits, yw):
+            y, w = yw
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return (ce * w).sum() / jnp.maximum(jax.lax.psum(w.sum(), "_ranks"), 1.0) * W
+
+        # build a step where loss_fn gets (y, w) tuple
+        step = ddp.make_train_step(opt, wloss)
+
+        ds = SyntheticMNIST(256)
+        x, y = ds[np.arange(64)]
+        w_full = np.ones((64,), np.float32)
+
+        p1, _, _ = step(ddp.params, opt.init(ddp.params), x, (y, w_full))
+
+        # same real data + an extra zero-weighted shadow copy appended
+        x2 = np.concatenate([x, x])
+        y2 = np.concatenate([y, y])
+        w2 = np.concatenate([w_full, np.zeros_like(w_full)])
+        ddp2 = tdx.DistributedDataParallel(model, params)
+        step2 = ddp2.make_train_step(opt, wloss)
+        p2, _, _ = step2(ddp2.params, opt.init(ddp2.params), x2, (y2, w2))
+
+        for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, world, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        opt = optax.sgd(0.05, momentum=0.9)
+        opt_state = opt.init(params)
+
+        path = save_checkpoint(
+            str(tmp_path / "ckpt"), params, opt_state, step=42, extra={"lr": 0.05}
+        )
+        p2, o2, step, extra = load_checkpoint(path, params, opt_state)
+        assert step == 42
+        assert extra["lr"] == 0.05
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p2)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(opt_state), jax.tree_util.tree_leaves(o2)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_structure_mismatch_rejected(self, tmp_path):
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        tree = {"a": jnp.ones((2,)), "b": jnp.zeros((3,))}
+        path = save_checkpoint(str(tmp_path / "c2"), tree)
+        with pytest.raises(ValueError, match="structure mismatch"):
+            load_checkpoint(path, {"a": jnp.ones((2,))})
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        tree = {"a": jnp.ones((2,))}
+        path = save_checkpoint(str(tmp_path / "c3"), tree)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            load_checkpoint(path, {"a": jnp.ones((5,))})
+
+    def test_resume_training_continues(self, world, tmp_path):
+        """Save mid-training, reload, verify the next step matches."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        ddp = tdx.DistributedDataParallel(model, params)
+        opt = optax.sgd(0.05, momentum=0.9)
+
+        def loss_fn(logits, y):
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        step = ddp.make_train_step(opt, loss_fn)
+        ds = SyntheticMNIST(256)
+        x, y = ds[np.arange(64)]
+
+        p, o = ddp.params, opt.init(ddp.params)
+        p, o, _ = step(p, o, x, y)
+        save_checkpoint(str(tmp_path / "mid"), p, o, step=1)
+        p_next, o_next, loss_a = step(p, o, x, y)
+
+        pr, orr, s, _ = load_checkpoint(str(tmp_path / "mid"), params, opt.init(params))
+        assert s == 1
+        # re-place on mesh and take the same step
+        ddp2 = tdx.DistributedDataParallel(model, pr)
+        step2 = ddp2.make_train_step(opt, loss_fn)
+        o2 = jax.device_put(orr)
+        p2_next, _, loss_b = step2(ddp2.params, o2, x, y)
+        assert abs(float(loss_a) - float(loss_b)) < 1e-6
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_next), jax.tree_util.tree_leaves(p2_next)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

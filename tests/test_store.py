@@ -207,3 +207,79 @@ class TestRendezvous:
 
         with pytest.raises(RendezvousError):
             next(iter(rendezvous("bogus://x")))
+
+
+class TestNativeStore:
+    """C++ epoll store (csrc/store.cpp): native↔native and mixed-peer
+    interop over the shared wire protocol."""
+
+    def test_native_available(self):
+        from pytorch_distributed_example_tpu import _native
+
+        assert _native.available(), "native lib should build in this env"
+
+    def test_native_roundtrip(self):
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0)
+        try:
+            assert m.native
+            _exercise(m)
+        finally:
+            m.close()
+
+    def test_python_client_native_server(self):
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0)
+        try:
+            assert m.native
+            c = TCPStore("127.0.0.1", m.port, timeout=3.0, use_native=False)
+            assert not c.native
+            m.set("a", b"1")
+            assert c.get("a") == b"1"
+            c.set("b", b"2")
+            assert m.get("b") == b"2"
+            assert c.add("n", 3) == 3
+            assert m.add("n", 4) == 7
+            c.close()
+        finally:
+            m.close()
+
+    def test_native_client_python_server(self):
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=3.0, use_native=False)
+        try:
+            assert not m.native
+            c = TCPStore("127.0.0.1", m.port, timeout=3.0)
+            assert c.native
+            m.set("x", b"9")
+            assert c.get("x") == b"9"
+            assert c.compare_set("cas", "", "v") == b"v"
+            assert m.get("cas") == b"v"
+            c.close()
+        finally:
+            m.close()
+
+
+class TestNativeBucketPlanner:
+    def test_matches_python(self):
+        from pytorch_distributed_example_tpu import _native
+        from pytorch_distributed_example_tpu.parallel.reducer import (
+            compute_bucket_assignment_by_size,
+        )
+
+        mb = 1024 * 1024
+        sizes = [mb // 2, mb // 2, mb // 2, 10 * mb, 30 * mb, 100, 200]
+        native = _native.compute_buckets(sizes, 25 * mb, mb)
+        assert native is not None
+        # python reference (force pure path)
+        import os
+
+        os.environ["TDX_NATIVE"] = "0"
+        try:
+            import importlib
+
+            from pytorch_distributed_example_tpu import _native as n2
+
+            n2._tried, n2._lib = False, None
+            py = compute_bucket_assignment_by_size(sizes)
+        finally:
+            os.environ.pop("TDX_NATIVE", None)
+            n2._tried, n2._lib = False, None
+        assert native == py
