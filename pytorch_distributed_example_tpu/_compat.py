@@ -1,0 +1,15 @@
+"""Version-compat shims shared across the package."""
+
+from __future__ import annotations
+
+
+def shard_map_fn(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions (new: check_vma, old: check_rep)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm_old  # type: ignore
+
+    return sm_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)

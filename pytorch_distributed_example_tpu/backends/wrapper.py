@@ -61,6 +61,11 @@ class ProcessGroupWrapper(Backend):
         if self.driver_mode:
             # one caller acts for every rank: publish once, self-consistent
             self.store.set(f"pgw/{seq}/all", fp)
+            if seq > 1 and hasattr(self.store, "delete_key"):
+                try:
+                    self.store.delete_key(f"pgw/{seq - 1}/all")
+                except Exception:
+                    pass
             return
         self.store.set(f"pgw/{seq}/{self.my_rank}", fp)
         keys = [f"pgw/{seq}/{r}" for r in range(self.world_size)]
@@ -72,6 +77,13 @@ class ProcessGroupWrapper(Backend):
                 f"collective mismatch at seq {seq}: rank {self.my_rank} ran "
                 f"{fp!r} but {bad}"
             )
+        # bound store growth: drop the previous round's keys (every rank has
+        # passed `wait` on round seq, so round seq-1 can no longer be read)
+        if seq > 1 and hasattr(self.store, "delete_key"):
+            try:
+                self.store.delete_key(f"pgw/{seq - 1}/{self.my_rank}")
+            except Exception:
+                pass
 
     # -- delegated collectives --------------------------------------------
     def allreduce(self, x, op: Any = ReduceOp.SUM):

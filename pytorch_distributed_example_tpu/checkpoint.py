@@ -6,9 +6,11 @@ by the example). Minimal-parity behavior implemented here:
 
   * DDP replication makes checkpointing rank-0-only (`save` is a host-side
     dump of the replicated pytree — SURVEY.md §5.4 "trivially rank-0-only").
-  * Sharded (GSPMD) params: `save` pulls the addressable shards through
-    `jax.device_get` into a full host tree (single-host driver mode owns
-    every shard); multi-host sharded save delegates to orbax when present.
+  * Sharded (GSPMD) params: `save` pulls the arrays through
+    `jax.device_get` into a full host tree. This is complete in single-host
+    driver mode (the driver owns every shard); true multi-host sharded
+    save/load (per-host shard files à la orbax/torch-dcp) is NOT implemented
+    yet — on multi-host deployments gather to host 0 before saving.
 
 Format: a directory with `meta.json` (step, tree structure) and `arrays.npz`
 (flattened leaves) — dependency-free, byte-stable, loadable without jax.

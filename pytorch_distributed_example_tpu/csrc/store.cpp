@@ -50,7 +50,14 @@ bool send_all(int fd, const void* buf, size_t n) {
   while (n > 0) {
     ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
     if (w <= 0) {
-      if (w < 0 && (errno == EINTR)) continue;
+      if (w < 0 && errno == EINTR) continue;
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        // daemon sockets are non-blocking (accept4 SOCK_NONBLOCK): a large
+        // response can overrun the send buffer — wait for writability
+        struct pollfd pf{fd, POLLOUT, 0};
+        if (::poll(&pf, 1, 30000) <= 0) return false;
+        continue;
+      }
       return false;
     }
     p += w;

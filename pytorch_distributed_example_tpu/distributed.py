@@ -127,7 +127,13 @@ class ProcessGroup:
         self.status.record_enqueue(seq, op_name, numel)
         rec = global_recorder()
         rec.record(seq, op_name, self.group_name, shape, dtype, numel)
-        out, work = fn()
+        try:
+            out, work = fn()
+        except Exception:
+            # a raised collective is a failure, not a hang: mark it so the
+            # flight recorder / status don't show it as forever-enqueued
+            rec.complete(seq, self.group_name, failed=True)
+            raise
         if self.watchdog is not None:
             self.watchdog.register(work, f"{self.group_name}:{op_name}:{seq}")
 

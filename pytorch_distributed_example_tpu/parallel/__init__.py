@@ -2,3 +2,23 @@ from .ddp import DistributedDataParallel, make_ddp_train_step, make_eval_step  #
 from .reducer import Reducer, compute_bucket_assignment_by_size  # noqa: F401
 from .join import Join, Joinable, JoinHook, join_batches  # noqa: F401
 from . import comm_hooks  # noqa: F401
+from . import sharding  # noqa: F401
+from .fsdp import FSDPModule, fully_shard, make_fsdp_train_step, shard_optimizer_only  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    ColwiseParallel,
+    RowwiseParallel,
+    SequenceParallel,
+    parallelize_module,
+)
+from .context_parallel import (  # noqa: F401
+    make_cp_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from .pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)

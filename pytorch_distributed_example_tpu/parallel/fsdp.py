@@ -1,0 +1,169 @@
+"""FSDP-equivalent: fully-sharded data parallelism as GSPMD param sharding.
+
+Parity surface: `torch/distributed/fsdp/` (SURVEY.md §2.3 row "DP sharded" —
+BASELINE.json stretch config #5 "FSDP full-shard → GSPMD"). The TPU-native
+design: parameters live sharded over the ``fsdp`` mesh axis
+(`NamedSharding`, dim-0 sharded); the train step is jit-compiled with those
+shardings, and XLA's SPMD partitioner inserts the per-layer all-gather
+(forward/backward) and reduce-scatter (grad) that torch FSDP schedules by
+hand — overlapped by XLA's latency-hiding scheduler rather than by
+FSDP's prefetch machinery.
+
+ZeRO stages map as: params sharded = ZeRO-3 (default); `shard_optimizer_only`
+(params replicated, optimizer state sharded) = ZeRO-1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+from . import sharding as shd
+
+
+class FSDPModule:
+    """A model whose params are fully sharded over a mesh axis.
+
+    Usage::
+
+        mod = fully_shard(model, params, mesh, axis="fsdp")
+        step = mod.make_train_step(optimizer, loss_fn)
+        params, opt_state, loss = step(mod.params, opt_state, x, y)
+    """
+
+    def __init__(self, module, params, mesh, axis: str, specs, data_axes):
+        self.module = module
+        self.params = params
+        self.mesh = mesh
+        self.axis = axis
+        self.param_specs = specs
+        self.data_axes = tuple(data_axes)
+
+    def __call__(self, x, *args, **kwargs):
+        return self.module.apply(self.params, x, *args, **kwargs)
+
+    def make_train_step(
+        self,
+        optimizer,
+        loss_fn: Callable,
+        has_rng: bool = False,
+        remat: bool = False,
+        donate: bool = True,
+    ):
+        return make_fsdp_train_step(
+            self.module.apply,
+            loss_fn,
+            optimizer,
+            self.mesh,
+            self.param_specs,
+            data_axes=self.data_axes,
+            has_rng=has_rng,
+            remat=remat,
+            donate=donate,
+        )
+
+    def gather_params(self):
+        """Full (unsharded) params on host — rank-0-checkpoint substrate."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: jax.device_get(x), self.params)
+
+
+def fully_shard(
+    module,
+    params,
+    mesh,
+    axis: str = "fsdp",
+    rules: Optional[Sequence[shd.Rule]] = None,
+    data_axes: Sequence[str] = ("dp", "fsdp"),
+) -> FSDPModule:
+    """Shard ``params`` dim-0 over ``mesh[axis]`` (torch `fully_shard` shape).
+
+    ``rules`` overrides the catch-all dim-0 rule for custom layouts (e.g.
+    combined fsdp+tp). Leaves whose dim 0 is not divisible by the axis size
+    stay replicated (FSDP's small-param behavior).
+    """
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    if axis not in dict(jmesh.shape):
+        raise ValueError(f"mesh has no axis {axis!r}: {tuple(dict(jmesh.shape))}")
+    sharded, specs = shd.shard_params(params, jmesh, rules or shd.fsdp_rules(axis))
+    present = [a for a in data_axes if a in dict(jmesh.shape)]
+    return FSDPModule(module, sharded, jmesh, axis, specs, present or (axis,))
+
+
+def make_fsdp_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    mesh,
+    param_specs,
+    data_axes: Sequence[str] = ("dp", "fsdp"),
+    has_rng: bool = False,
+    remat: bool = False,
+    donate: bool = True,
+):
+    """Compile the FSDP train step: batch split over data axes, params
+    sharded per ``param_specs``; XLA GSPMD materializes gather/scatter.
+    """
+    import jax
+    import optax  # noqa: F401  (optimizer protocol)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    data_axes = tuple(a for a in data_axes if a in dict(jmesh.shape))
+    if not data_axes:
+        raise ValueError(
+            f"none of data_axes present in mesh axes {tuple(dict(jmesh.shape))}; "
+            "pass data_axes matching your mesh (e.g. data_axes=('fsdp',))"
+        )
+    batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
+
+    def step(params, opt_state, x, y, *rng):
+        def objective(p):
+            if has_rng:
+                fwd = lambda pp: apply_fn(pp, x, rngs={"dropout": rng[0]})
+            else:
+                fwd = lambda pp: apply_fn(pp, x)
+            if remat:
+                fwd = jax.checkpoint(fwd)
+            return loss_fn(fwd(p), y)
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        # keep grads in the param layout (reduce-scatter falls out of SPMD)
+        grads = shd.constrain(grads, jmesh, param_specs)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        params = shd.constrain(params, jmesh, param_specs)
+        return params, opt_state, loss
+
+    pshard = jax.tree_util.tree_map(lambda s: NamedSharding(jmesh, s), param_specs)
+    xshard = NamedSharding(jmesh, batch_spec)
+    rep = NamedSharding(jmesh, P())
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(pshard, None, xshard, xshard) + ((rep,) if has_rng else ()),
+        out_shardings=(pshard, None, rep),
+        donate_argnums=(0, 1) if donate else (),
+    )
+    return jitted
+
+
+def shard_optimizer_only(opt_state, mesh, axis: str = "fsdp"):
+    """ZeRO-1 layout for the optimizer state: shard its array leaves dim-0
+    over ``axis``. Params are untouched (keep them replicated, e.g. via
+    `DistributedDataParallel`); returns the re-placed opt_state."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    rules = shd.fsdp_rules(axis)
+
+    def place(x):
+        if hasattr(x, "shape") and x.ndim >= 1:
+            spec = shd.spec_for("opt", tuple(x.shape), rules, jmesh)
+        else:
+            spec = P()
+        return jax.device_put(x, NamedSharding(jmesh, spec))
+
+    return jax.tree_util.tree_map(place, opt_state)

@@ -1,0 +1,130 @@
+"""Pipeline parallelism — stage-sliced shard_map + collective-permute.
+
+Parity surface: `torch/distributed/pipelining/` (SURVEY.md §2.3 row PP).
+TPU-native design (scaling-book recipe): the ``pp`` mesh axis holds one
+pipeline stage per device group; stage parameters are stacked on a leading
+stage dim sharded over ``pp``; a GPipe schedule runs M microbatches through
+S stages in M+S-1 ticks, shifting activations one hop along the ICI ring
+with `lax.ppermute` each tick. The whole schedule is ONE compiled program —
+bubbles and comm overlap are visible to (and optimized by) XLA, and
+`jax.grad` differentiates straight through it (ppermute's transpose is the
+reverse permute), so there is no hand-written backward schedule à la
+torch pipelining's `ScheduleGPipe` runtime.
+
+API:
+  * `pipeline_apply(stage_fn, stage_params, x, axis_name, ...)` — inside
+    shard_map: push microbatches through the ring.
+  * `make_pipeline_fn(...)` — jit-ready wrapper: takes global inputs,
+    shards params over ``pp``, returns global outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str = "pp"):
+    """GPipe forward inside shard_map.
+
+    stage_fn(params_for_stage, activation) -> activation (same shape).
+    stage_params: this stage's param pytree (leading stage dim already
+    consumed by shard_map's in_spec).
+    x: (M, mb, ...) microbatched input, replicated across stages (only
+    stage 0 reads it). Returns (M, mb, ...) final-stage outputs,
+    replicated via psum so every stage exits with the result.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x.shape[0]
+    mb_shape = x.shape[1:]
+    T = M + S - 1  # total ticks
+
+    shift_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(t, carry):
+        state, out = carry
+        # stage 0 ingests microbatch t (dummy past the end); others use the
+        # activation shifted in from the previous stage
+        mb_idx = jnp.minimum(t, M - 1)
+        fresh = lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
+        inp = jnp.where(stage == 0, fresh, state)
+        y = stage_fn(stage_params, inp)
+        # last stage banks its result at output slot t - (S - 1)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = jnp.logical_and(stage == S - 1, t >= S - 1)
+        cur = lax.dynamic_index_in_dim(out, out_idx, axis=0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, cur), out_idx, axis=0
+        )
+        # shift activations one hop along the ring for the next tick
+        state = lax.ppermute(y, axis_name, shift_perm)
+        return state, out
+
+    state0 = jnp.zeros(mb_shape, x.dtype)
+    out0 = jnp.zeros((M,) + mb_shape, x.dtype)
+    _, out = lax.fori_loop(0, T, tick, (state0, out0))
+    # replicate the last stage's banked outputs to every stage
+    mask = (stage == S - 1).astype(out.dtype)
+    return lax.psum(out * mask, axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack S per-stage pytrees on a new leading dim (to shard over pp)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params
+    )
+
+
+def make_pipeline_fn(
+    stage_fn: Callable,
+    mesh,
+    axis_name: str = "pp",
+    jit: bool = True,
+):
+    """Wrap `pipeline_apply` into a jit-ready global-view callable.
+
+    Returned fn(stacked_params, x) takes stage-stacked params
+    (leading dim S, sharded over ``pp``) and microbatched input (M, mb, ...)
+    (replicated), and returns (M, mb, ...) outputs (replicated).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    from .._compat import shard_map_fn
+
+    def consume_stage_dim(p, x):
+        # shard_map hands each stage a (1, ...) slice; drop the stage dim
+        import jax as _jax
+
+        local = _jax.tree_util.tree_map(lambda l: l[0], p)
+        return pipeline_apply(stage_fn, local, x, axis_name)
+
+    mapped = shard_map_fn(
+        consume_stage_dim,
+        mesh=jmesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
+def split_microbatches(x, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...) microbatch view."""
+    B = x.shape[0]
+    if B % num_microbatches != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {num_microbatches}")
+    return x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+
+
+def merge_microbatches(y):
+    """(M, mb, ...) -> (B, ...)."""
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])

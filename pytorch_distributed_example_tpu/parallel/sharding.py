@@ -1,0 +1,148 @@
+"""GSPMD sharding utilities — the TPU-native answer to DTensor/FSDP layout.
+
+Parity surface: torch `torch/distributed/tensor/` (DTensor placements) and
+`torch/distributed/fsdp/` (parameter sharding) — SURVEY.md §2.3. The
+TPU-native design is NOT a DTensor port: placement = `PartitionSpec` over a
+named `jax.sharding.Mesh` axis, and XLA's SPMD partitioner inserts the
+all-gathers/reduce-scatters that FSDP/DTensor implement by hand. These
+helpers own the rule → spec → `NamedSharding` translation so models and
+wrappers never touch jax.sharding directly.
+
+Rule model (scaling-book style): a rule table maps parameter-path substrings
+(joined flax path, e.g. ``"layers_0/attn/q_proj/kernel"``) to a
+`PartitionSpec`-shaped tuple of mesh-axis names (or None). First match wins;
+no match = replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+AxisName = Optional[Union[str, Tuple[str, ...]]]
+Rule = Tuple[str, Tuple[AxisName, ...]]
+
+
+def _partition_spec(axes: Sequence[AxisName]):
+    from jax.sharding import PartitionSpec as P
+
+    return P(*axes)
+
+
+def path_of(key_path) -> str:
+    """Join a jax tree_util key path into a flat ``a/b/c`` string."""
+    import jax
+
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for(path: str, shape: Tuple[int, ...], rules: Sequence[Rule], mesh=None):
+    """First-match rule lookup → PartitionSpec, validated against the shape.
+
+    A rule axis is dropped (replicated) when the dimension is not divisible
+    by the mesh-axis size — the same graceful degradation FSDP applies to
+    small leftover parameters.
+    """
+    for pat, axes in rules:
+        if re.search(pat, path):
+            if len(axes) > len(shape):
+                continue
+            padded = tuple(axes) + (None,) * (len(shape) - len(axes))
+            if mesh is not None:
+                axis_sizes = dict(mesh.shape)  # jax Mesh.shape is an OrderedDict
+                checked = []
+                for dim, ax in zip(shape, padded):
+                    if ax is None:
+                        checked.append(None)
+                        continue
+                    size = 1
+                    for a in (ax if isinstance(ax, tuple) else (ax,)):
+                        if a not in axis_sizes:
+                            raise ValueError(
+                                f"sharding rule {pat!r} names mesh axis {a!r} but the "
+                                f"mesh only has axes {tuple(axis_sizes)} (param path "
+                                f"{path!r})"
+                            )
+                        size *= axis_sizes[a]
+                    checked.append(ax if dim % size == 0 else None)
+                padded = tuple(checked)
+            while padded and padded[-1] is None:
+                padded = padded[:-1]
+            return _partition_spec(padded)
+    return _partition_spec(())
+
+
+def make_param_specs(params, rules: Sequence[Rule], mesh=None):
+    """Pytree of PartitionSpecs matching ``params``, via the rule table."""
+    import jax
+
+    def leaf_spec(key_path, leaf):
+        return spec_for(path_of(key_path), tuple(leaf.shape), rules, mesh)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def shard_params(params, mesh, rules: Sequence[Rule]):
+    """Place ``params`` onto ``mesh`` per the rule table (device_put).
+
+    ``mesh`` is a framework `DeviceMesh` or a raw `jax.sharding.Mesh`.
+    Returns (sharded_params, spec_pytree).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    specs = make_param_specs(params, rules, jmesh)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(jmesh, s)), params, specs
+    )
+    return sharded, specs
+
+
+def constrain(tree, mesh, specs):
+    """`lax.with_sharding_constraint` over a pytree (inside jit)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(jmesh, s)),
+        tree,
+        specs,
+    )
+
+
+def fsdp_rules(axis: str = "fsdp") -> Sequence[Rule]:
+    """Catch-all rule used by `fsdp.fully_shard`: shard dim 0 of everything.
+
+    (The divisibility check in `spec_for` leaves odd-shaped leaves
+    replicated, matching FSDP's handling of small params.)
+    """
+    return [(r".*", (axis,))]
+
+
+def replicated_specs(params):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def data_spec(mesh, batch_axes: Sequence[str] = ("dp",)):
+    """PartitionSpec for a batch: leading dim over the data axes."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(a for a in batch_axes if a in getattr(mesh, "axis_names", batch_axes))
+    if len(axes) == 1:
+        return P(axes[0])
+    return P(axes)

@@ -1,0 +1,131 @@
+"""Tensor parallelism — Megatron-style column/row sharding via GSPMD.
+
+Parity surface: `torch/distributed/tensor/parallel/` (`parallelize_module`,
+`ColwiseParallel`, `RowwiseParallel`) — SURVEY.md §2.3 row TP. The
+TPU-native design: a TP "style" is just a PartitionSpec on the weight —
+column-parallel = output dim over the ``tp`` axis, row-parallel = input dim
+over ``tp`` — and XLA's SPMD partitioner inserts the single all-reduce per
+(colwise → rowwise) pair that Megatron inserts by hand. No manual psum, no
+module surgery: `parallelize_module` returns sharded params + specs to feed
+jit.
+
+For the explicit/eager path (and for tests that want to see the collective),
+`column_parallel_matmul` / `row_parallel_matmul` implement the same math
+inside `shard_map` with an explicit `lax.psum` — reference-shaped seams
+(Megatron f/g operators).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from . import sharding as shd
+
+
+@dataclass
+class ColwiseParallel:
+    """Shard a linear layer's output features over ``tp`` (Megatron column).
+
+    kernel (in, out) → P(None, "tp"); bias (out,) → P("tp").
+    """
+
+    axis: str = "tp"
+
+
+@dataclass
+class RowwiseParallel:
+    """Shard a linear layer's input features over ``tp`` (Megatron row).
+
+    kernel (in, out) → P("tp", None); bias replicated (added after the
+    implicit all-reduce).
+    """
+
+    axis: str = "tp"
+
+
+@dataclass
+class SequenceParallel:
+    """Replicate weights; activations sharded on sequence (used with norms)."""
+
+    axis: str = "sp"
+
+
+ParallelStyle = Any
+
+
+def tp_rules_for_plan(plan: Dict[str, ParallelStyle]) -> Sequence[shd.Rule]:
+    """Translate a torch-`parallelize_module`-shaped plan into rule entries.
+
+    Keys are path substrings/regexes (module names); values are styles.
+    """
+    rules = []
+    for pat, style in plan.items():
+        if isinstance(style, ColwiseParallel):
+            rules.append((pat + r".*/kernel", (None, style.axis)))
+            rules.append((pat + r".*/bias", (style.axis,)))
+            rules.append((pat + r".*/embedding", (None, style.axis)))
+        elif isinstance(style, RowwiseParallel):
+            rules.append((pat + r".*/kernel", (style.axis, None)))
+            rules.append((pat + r".*/bias", (None,)))
+            rules.append((pat + r".*/embedding", (style.axis, None)))
+        elif isinstance(style, SequenceParallel):
+            rules.append((pat + r".*", (None,)))
+        else:
+            raise TypeError(f"unknown parallel style {style!r}")
+    return rules
+
+
+def parallelize_module(params, mesh, plan: Dict[str, ParallelStyle]):
+    """Shard ``params`` per the TP plan — torch
+    `torch.distributed.tensor.parallel.parallelize_module` equivalent.
+
+    Returns (sharded_params, spec_pytree); feed the specs to jit
+    in_shardings/`sharding.constrain` and GSPMD does the rest.
+    """
+    rules = list(tp_rules_for_plan(plan))
+    rules.append((r".*", ()))  # everything else replicated
+    return shd.shard_params(params, mesh, rules)
+
+
+# ---------------------------------------------------------------------------
+# explicit shard_map seams (Megatron f/g operators, for eager/test use)
+# ---------------------------------------------------------------------------
+
+
+def column_parallel_matmul(x, w_local, axis: str = "tp"):
+    """y_local = x @ w_local inside shard_map; output features sharded.
+
+    The identity forward / psum backward "f operator": call within a
+    shard_map whose in_spec replicates x and shards w on dim -1.
+    """
+    import jax.numpy as jnp
+
+    return jnp.dot(x, w_local, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def row_parallel_matmul(x_local, w_local, axis: str = "tp"):
+    """y = psum(x_local @ w_local) inside shard_map; the "g operator"."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    partial = jnp.dot(x_local, w_local, preferred_element_type=jnp.float32)
+    return lax.psum(partial, axis).astype(x_local.dtype)
+
+
+def mlp_block_tp(x, w_up_local, w_down_local, axis: str = "tp", act=None):
+    """A full Megatron MLP block (colwise up, rowwise down, one psum)."""
+    import jax.nn
+
+    act = act or jax.nn.gelu
+    h = column_parallel_matmul(x, w_up_local, axis)
+    return row_parallel_matmul(act(h), w_down_local, axis)
+
+
+def vocab_parallel_logits(h, emb_local, axis: str = "tp"):
+    """Vocab-parallel LM head: local logits chunk, all-gathered on last dim."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    local = jnp.dot(h, emb_local, preferred_element_type=jnp.float32)
+    return lax.all_gather(local, axis, axis=local.ndim - 1, tiled=True)
