@@ -1,0 +1,329 @@
+"""Flash attention — Pallas TPU kernels (forward + backward), custom VJP.
+
+The hot op of every transformer in this framework (SURVEY.md §2.3's
+long-context obligation; used standalone, under Ulysses, and as the block
+kernel behind sequence parallelism). Design per the TPU kernel playbook
+(/opt/skills/guides/pallas_guide.md):
+
+* forward: one grid step per (batch·head, q-block); K/V stream through a
+  `fori_loop` of `block_k` slices held in VMEM; online-softmax accumulator
+  in fp32; logits never materialize in HBM (O(L) memory, not O(L²)).
+  The MXU sees (block_q, D) @ (D, block_k) matmuls with
+  `preferred_element_type=float32`.
+* backward: flash-style recomputation — saves only (O, LSE) residuals;
+  one kernel produces dK/dV (grid over k-blocks, loop over q-blocks), a
+  second produces dQ (grid over q-blocks, loop over k-blocks). `delta =
+  rowsum(dO·O)` is a cheap jnp preprocess.
+* causal masking by global positions; diagonal blocks are masked
+  elementwise, blocks strictly above the diagonal are skipped by bounding
+  the k-loop (upper-triangular work never executes).
+
+On non-TPU backends (the 8-device CPU test mesh) the kernels run in
+interpreter mode automatically — same code path, bitwise-comparable math.
+
+Layout note: public API takes (B, L, H, D) to match
+`parallel/context_parallel.py`; kernels internally use (B·H, L, D).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    """Compile only where Mosaic can lower (a TPU device); interpret elsewhere.
+
+    Checked via device platform, not just backend name, so TPU plugins
+    registered under other platform names still get the compiled path.
+    """
+    if jax.default_backend() == "tpu":
+        return False
+    try:
+        return not any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, seq_len):
+    D = q_ref.shape[-1]
+    i = pl.program_id(1)
+    q_start = i * block_q
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+
+    num_k = seq_len // block_k
+    if causal:
+        # last k-block that intersects the triangle for this q block
+        num_k_eff = (q_start + block_q - 1) // block_k + 1
+    else:
+        num_k_eff = num_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(m - m_new)  # finite: both -1e30 → exp(0)=1, acc is 0
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_k_eff, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse carried as (..., 1): TPU block tiling wants the lane dim equal to
+    # the (size-1) array dim, with block_q on the sublane axis
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: (BH, L, D) → (o, lse)."""
+    BH, L, D = q.shape
+    grid = (BH, L // block_q)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=L,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, block_q, block_k, seq_len
+):
+    D = q_ref.shape[-1]
+    j = pl.program_id(1)
+    k_start = j * block_k
+    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q = seq_len // block_q
+    if causal:
+        first_q = k_start // block_q  # first q-block intersecting the triangle
+    else:
+        first_q = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (bq, bk); masked → exp(NEG_INF-lse)=0
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dlogits = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(dlogits.T, q, preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = lax.fori_loop(first_q, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal, block_q, block_k, seq_len
+):
+    D = q_ref.shape[-1]
+    i = pl.program_id(1)
+    q_start = i * block_q
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    num_k = seq_len // block_k
+    num_k_eff = (q_start + block_q - 1) // block_k + 1 if causal else num_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dlogits = p * (dp - delta[:, None])
+        return dq + jnp.dot(dlogits, k, preferred_element_type=jnp.float32) * scale
+
+    dq = lax.fori_loop(0, num_k_eff, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
+    BH, L, D = q.shape
+    # (BH, L, 1) — same tiling story as lse
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
+
+    dkdv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=L,
+        ),
+        grid=(BH, L // block_k),
+        in_specs=[
+            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # q (full)
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # k block
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # v block
+            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # do (full)
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # lse (full)
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # delta (full)
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = dkdv
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=L,
+        ),
+        grid=(BH, L // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q block
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # k (full)
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # v (full)
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do block
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse block
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta block
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API (custom VJP over (B, L, H, D))
+# ---------------------------------------------------------------------------
+
+
+def _to_bh(x):
+    # (B, L, H, D) -> (B*H, L, D)
+    B, L, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+
+def _from_bh(x, B, H):
+    BH, L, D = x.shape
+    return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention over (B, L, H, D) tensors; differentiable.
+
+    Constraints: L divisible by block sizes (pad upstream); K/V for one
+    head must fit VMEM (L·D·4 bytes ≤ ~4 MB ⇒ L ≤ 8k at D=128) — the
+    streaming-HBM variant for longer L is ring attention over the mesh
+    (parallel/context_parallel.py), which calls this kernel per shard.
+    """
+    B, L, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    bq, bk = min(block_q, L), min(block_k, L)
+    if L % bq or L % bk:
+        raise ValueError(f"seq len {L} must be divisible by block sizes ({bq},{bk})")
+    if interpret is None:
+        interpret = _interpret_default()
+    o = _flash(_to_bh(q), _to_bh(k), _to_bh(v), scale, causal, bq, bk, interpret)
+    return _from_bh(o, B, H)
