@@ -1,1 +1,7 @@
 from .convnet import ConvNet  # noqa: F401
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    sharding_rules as transformer_sharding_rules,
+)

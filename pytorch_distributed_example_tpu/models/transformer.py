@@ -1,0 +1,201 @@
+"""TransformerLM — the framework's flagship model (Llama-style decoder,
+BERT-style encoder via `causal=False`).
+
+Covers BASELINE.json configs #4/#5 ("BERT-base fine-tune", "Llama-3-8B
+FSDP full-shard → GSPMD"; SURVEY.md §6). TPU-native design:
+
+* RMSNorm + RoPE + SwiGLU + grouped-query attention (Llama topology);
+* attention runs the Pallas flash kernel (`ops/flash_attention.py`) on
+  TPU, dense softmax elsewhere/when disabled;
+* bf16-friendly: params fp32, activations cast to `dtype`, logits fp32;
+* `sharding_rules()` emits the canonical 2-D Megatron(+ZeRO) GSPMD layout
+  (scaling-book recipe): attention/MLP in-features over ``fsdp``,
+  head/ffn out-features over ``tp`` — XLA inserts the one all-reduce per
+  block pair that Megatron hand-codes;
+* `nn.remat` per block when `remat=True` (HBM ↔ FLOPs trade, SURVEY task
+  note on `jax.checkpoint`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None = MHA; < n_heads = GQA
+    d_ff: Optional[int] = None  # None = 4 * d_model (SwiGLU sizes 2/3 * that)
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    causal: bool = True
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    use_flash: bool = True
+    remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        # Llama convention: 2/3 * 4d rounded to a multiple of 128
+        d = int(2 * 4 * self.d_model / 3)
+        return (d + 127) // 128 * 128
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)  # (L, head_dim/2)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, L, H, D); rotate pairs (even, odd) by position angle."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _dense_attention(q, k, v, causal, scale):
+    from ..ops.reference import dense_attention
+
+    return dense_attention(q, k, v, causal=causal, scale=scale)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.cfg
+        B, L, _ = x.shape
+        H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        q = dense(H * Dh, "q_proj")(x).reshape(B, L, H, Dh)
+        k = dense(KV * Dh, "k_proj")(x).reshape(B, L, KV, Dh)
+        v = dense(KV * Dh, "v_proj")(x).reshape(B, L, KV, Dh)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if KV != H:  # GQA: repeat kv groups to full heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / (Dh ** 0.5)
+        if cfg.use_flash and _flash_ok(L, Dh):
+            from ..ops import flash_attention
+
+            o = flash_attention(q, k, v, causal=cfg.causal, scale=scale)
+        else:
+            o = _dense_attention(q, k, v, cfg.causal, scale)
+        o = o.reshape(B, L, H * Dh)
+        return dense(cfg.d_model, "o_proj")(o)
+
+
+def _flash_ok(L: int, Dh: int) -> bool:
+    # kernel constraint: L divisible by the (clamped) block size
+    b = min(128, L)
+    return L % b == 0 and Dh <= 256
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        F = cfg.ffn_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        gate = dense(F, "gate_proj")(x)
+        up = dense(F, "up_proj")(x)
+        return dense(cfg.d_model, "down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin)
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens: (B, L) int32 → logits (B, L, vocab) fp32."""
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_embed"
+        )(tokens)
+        cos, sin = rope_freqs(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
+        block_cls = nn.remat(Block) if cfg.remat else Block
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def sharding_rules(
+    tp_axis: str = "tp", fsdp_axis: Optional[str] = "fsdp"
+) -> Sequence[Tuple[str, Tuple]]:
+    """Canonical 2-D GSPMD layout for TransformerLM params.
+
+    Megatron pairing: q/k/v/gate/up colwise over ``tp``; o/down rowwise
+    over ``tp``; ZeRO dimension over ``fsdp`` on the complementary dim.
+    Set ``fsdp_axis=None`` for pure TP.
+    """
+    f = fsdp_axis
+    return [
+        (r"tok_embed/embedding", (None, tp_axis)),
+        (r"(q_proj|k_proj|v_proj)/kernel", (f, tp_axis)),
+        (r"o_proj/kernel", (tp_axis, f)),
+        (r"(gate_proj|up_proj)/kernel", (f, tp_axis)),
+        (r"down_proj/kernel", (tp_axis, f)),
+        (r"lm_head/kernel", (f, tp_axis)),
+        (r"(attn_norm|mlp_norm|final_norm)/scale", (None,)),
+        (r".*", ()),
+    ]

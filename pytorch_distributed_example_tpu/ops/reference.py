@@ -1,0 +1,28 @@
+"""Reference (non-flash) attention — the numerics oracle for the kernels.
+
+Single source of truth for dense softmax attention over (B, L, H, D):
+used by models as the non-flash fallback, by Ulysses as the default local
+kernel, and by tests as the comparison target.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+NEG_INF = -1e30
+
+
+def dense_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    import jax
+    import jax.numpy as jnp
+
+    D = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        L, Lk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(L)[:, None] >= jnp.arange(Lk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)

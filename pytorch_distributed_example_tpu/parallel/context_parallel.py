@@ -167,20 +167,10 @@ def ulysses_attention(
 
 
 def _full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """Plain full-sequence softmax attention (B, L, H, D) — reference path."""
-    import jax
-    import jax.numpy as jnp
+    """Plain full-sequence softmax attention — shared oracle in ops/reference."""
+    from ..ops.reference import dense_attention
 
-    D = q.shape[-1]
-    if scale is None:
-        scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if causal:
-        L, Lk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(L)[:, None] >= jnp.arange(Lk)[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return dense_attention(q, k, v, causal=causal, scale=scale)
 
 
 def make_cp_attention(
