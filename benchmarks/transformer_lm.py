@@ -1,0 +1,125 @@
+"""TransformerLM training throughput — BASELINE.json configs #4/#5.
+
+Causal-LM train step over a (dp, fsdp, tp) mesh with the canonical 2-D
+GSPMD layout (models.transformer.sharding_rules). Default geometry is a
+BERT-base-scale model (12L/768d/12H); `--preset llama8b-ish` scales the
+config toward the stretch target (fits only on real pods — use with
+--dry). Reports tokens/s/chip and model FLOP/s utilization-style totals.
+
+Usage:
+  python benchmarks/transformer_lm.py [--preset base|small] [--seq 512]
+      [--batch 8] [--bf16] [--tp 1] [--fsdp N] [--flash/--no-flash]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+PRESETS = {
+    "small": dict(vocab_size=32000, d_model=256, n_layers=4, n_heads=8),
+    "base": dict(vocab_size=32000, d_model=768, n_layers=12, n_heads=12),
+    "large": dict(vocab_size=32000, d_model=1024, n_layers=24, n_heads=16),
+    "llama8b-ish": dict(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336,
+    ),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="base")
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8, help="global batch")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--fsdp", type=int, default=0, help="0 = all remaining devices")
+    ap.add_argument("--no-flash", action="store_true")
+    ap.add_argument("--remat", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_example_tpu.mesh import init_device_mesh
+    from pytorch_distributed_example_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+        transformer_sharding_rules,
+    )
+    from pytorch_distributed_example_tpu.parallel import fully_shard
+    from benchmarks.common import emit
+
+    n_dev = len(jax.devices())
+    tp = args.tp
+    fsdp = args.fsdp or (n_dev // tp)
+    dp = n_dev // (tp * fsdp)
+    mesh = init_device_mesh(("dp", "fsdp", "tp"), (dp, fsdp, tp))
+
+    kw = dict(PRESETS[args.preset])
+    cfg = TransformerConfig(
+        max_seq_len=args.seq,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        use_flash=not args.no_flash,
+        remat=args.remat,
+        **kw,
+    )
+    model = TransformerLM(cfg)
+    gen = np.random.default_rng(0)
+    toks = jnp.asarray(
+        gen.integers(0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), toks[:1, :])
+
+    mod = fully_shard(
+        model, params, mesh, axis="fsdp",
+        rules=transformer_sharding_rules("tp", "fsdp"),
+        data_axes=("dp", "fsdp"),
+    )
+    opt = optax.adamw(1e-4)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], y[:, 1:]
+        ).mean()
+
+    step = mod.make_train_step(opt, loss_fn)
+    opt_state = opt.init(mod.params)
+
+    p, s = mod.params, opt_state
+    for _ in range(args.warmup):
+        p, s, loss = step(p, s, toks, toks)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        p, s, loss = step(p, s, toks, toks)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = args.steps * args.batch * args.seq
+    per_chip = tokens / dt / n_dev
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # 6ND + attention-flops estimate for train step
+    flops = 6.0 * n_params * tokens + 12.0 * kw["n_layers"] * kw["d_model"] * args.seq * tokens
+    emit(
+        f"transformer_{args.preset}_tokens_per_sec_per_chip",
+        per_chip,
+        "tokens/s/chip",
+        world=n_dev,
+        mesh=f"dp{dp}xfsdp{fsdp}xtp{tp}",
+        params_m=round(n_params / 1e6, 1),
+        model_tflops_per_sec=round(flops / dt / 1e12, 2),
+        loss=round(float(loss), 4),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,15 @@ from .distributed import (  # noqa: F401
     all_gather_object,
     broadcast_object_list,
     monitored_barrier,
+    all_gather_into_tensor,
+    all_to_all_single,
+    reduce_scatter_tensor,
+    split_group,
+    shrink_group,
+    gather_object,
+    get_group_rank,
+    get_global_rank,
+    coalescing_manager,
 )
 from .data.sampler import DistributedSampler  # noqa: F401
 from .parallel.ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401

@@ -28,6 +28,7 @@ import logging
 import os
 import pickle
 import sys
+from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -605,6 +606,182 @@ def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False):
                 break
     if missing:
         raise RuntimeError(f"monitored_barrier: rank(s) {missing} failed to arrive")
+
+
+def all_gather_into_tensor(tensor, group=None, async_op: bool = False):
+    """torch `all_gather_into_tensor` (`distributed_c10d.py:4404`): like
+    `all_gather` but the result is one concatenated tensor — per-rank value
+    (W*n, *s) instead of the stacked (W, n, *s) list form."""
+    g = _resolve(group)
+    res = all_gather(tensor, g, async_op=async_op)
+    dt, work = res if async_op else (res, None)
+    # per-rank value is (W, n, *s); merge the first two dims. Scalar
+    # per-rank tensors gather to per-rank (W,) and are already merged.
+    arr = dt.array
+    W = g.size()
+    if arr.ndim == 2:
+        merged = arr
+    else:
+        merged = arr.reshape((arr.shape[0], W * arr.shape[2]) + tuple(arr.shape[3:]))
+    out = DistTensor(merged, g)
+    return (out, work) if async_op else out
+
+
+def all_to_all_single(tensor, group=None, async_op: bool = False):
+    """torch `all_to_all_single` (`distributed_c10d.py:4996`): per-rank
+    value is one (W*n, *s) tensor whose i-th chunk goes to rank i; output
+    is the same shape with chunk i received from rank i. Equal splits only
+    (the torch uneven-split variant pads upstream)."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    W = g.size()
+    n_total = dt.shape[0]
+    if n_total % W != 0:
+        raise ValueError(f"all_to_all_single: leading dim {n_total} not divisible by world {W}")
+    chunk = n_total // W
+    arr = dt.array  # (W, W*chunk, *s) rank-stacked
+    split = arr.reshape((arr.shape[0], W, chunk) + tuple(arr.shape[2:]))
+    split_dt = DistTensor(split, g)
+    out = all_to_all(split_dt, g)
+    res_arr = out.array.reshape(arr.shape)
+    res = DistTensor(res_arr, g)
+    if async_op:
+        return res, CompletedWork(res, OpType.ALLTOALL)
+    return res
+
+
+def reduce_scatter_tensor(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool = False):
+    """torch `reduce_scatter_tensor`: input per-rank value (W*n, *s) is
+    treated as W chunks; each rank receives its reduced chunk (n, *s)."""
+    g = _resolve(group)
+    dt = _as_dist(tensor, g)
+    W = g.size()
+    if dt.shape[0] % W != 0:
+        raise ValueError(f"reduce_scatter_tensor: leading dim {dt.shape[0]} not divisible by {W}")
+    chunk = dt.shape[0] // W
+    arr = dt.array.reshape((dt.array.shape[0], W, chunk) + tuple(dt.array.shape[2:]))
+    return reduce_scatter(DistTensor(arr, g), op, g, async_op=async_op)
+
+
+def split_group(
+    parent_pg: Optional[ProcessGroup] = None,
+    split_ranks: Optional[List[List[int]]] = None,
+    timeout=None,
+    group_desc: Optional[str] = None,
+) -> Optional[ProcessGroup]:
+    """torch `split_group` (`distributed_c10d.py:5517`): partition the
+    parent group into disjoint subgroups (backed by mesh slicing — the
+    XLA analog of ncclCommSplit). Returns the calling rank's subgroup."""
+    parent = _resolve(parent_pg)
+    if not split_ranks:
+        raise ValueError("split_ranks must be a non-empty list of rank lists")
+    seen: set = set()
+    for rs in split_ranks:
+        for r in rs:
+            if r in seen:
+                raise ValueError(f"rank {r} appears in more than one split")
+            seen.add(r)
+            if r not in parent.ranks:
+                raise ValueError(f"rank {r} not in parent group {parent.ranks}")
+    me = _world.process_rank  # global rank domain, same as split_ranks
+    mine = first = None
+    for idx, rs in enumerate(split_ranks):
+        g = new_group(rs, timeout=timeout, group_desc=(
+            f"{group_desc or 'split'}_{idx}"
+        ))
+        if first is None:
+            first = g
+        if me in rs:
+            mine = g
+    if mine is None and _world.mode == "driver":
+        # the driver holds every rank; "its" subgroup defaults to the first
+        mine = first
+    return mine
+
+
+def shrink_group(
+    ranks_to_exclude: Sequence[int], group: Optional[ProcessGroup] = None, timeout=None
+) -> ProcessGroup:
+    """torch `shrink_group` (`distributed_c10d.py:6368`): rebuild the group
+    without the excluded (e.g. failed) ranks — the recovery primitive the
+    NCCL backend gates on comm shrink support. Here it is a mesh re-slice;
+    when the default group shrinks, the world is replaced in place."""
+    g = _resolve(group)
+    excl = set(int(r) for r in ranks_to_exclude)
+    bad = excl - set(g.ranks)
+    if bad:
+        raise ValueError(f"ranks {sorted(bad)} not part of group {g.ranks}")
+    keep = [r for r in g.ranks if r not in excl]
+    if not keep:
+        raise ValueError("cannot shrink a group to zero ranks")
+    is_default = g is _world.default_pg
+    ng = new_group(keep, timeout=timeout, group_desc=f"{g.group_name}_shrunk")
+    if is_default:
+        _world.default_pg = ng
+        GroupMember.WORLD = ng
+    return ng
+
+
+def gather_object(obj: Any, object_gather_list: Optional[List[Any]] = None, dst: int = 0, group=None):
+    """torch `gather_object`: driver mode gathers every rank's object (the
+    per-rank objects come from `obj` when it is a per-rank list)."""
+    g = _resolve(group)
+    W = g.size()
+    if not (isinstance(obj, list) and len(obj) == W):
+        raise ValueError(
+            f"driver mode: gather_object takes the per-rank object list "
+            f"(length {W}), like all_gather_object"
+        )
+    gathered = all_gather_object(obj, g)
+    if object_gather_list is not None:
+        del object_gather_list[:]
+        object_gather_list.extend(gathered)
+    return gathered
+
+
+def get_group_rank(group: ProcessGroup, global_rank: int) -> int:
+    """torch module-level `get_group_rank`."""
+    return _resolve(group).get_group_rank(global_rank)
+
+
+def get_global_rank(group: ProcessGroup, group_rank: int) -> int:
+    """torch module-level `get_global_rank`."""
+    return _resolve(group).get_global_rank(group_rank)
+
+
+class _CoalescingManager:
+    """torch `_coalescing_manager` analog: batch async works; wait at exit.
+
+    Under XLA the batching itself is automatic (each collective is an async
+    dispatch; XLA overlaps them), so the manager's contract reduces to
+    collecting the works and waiting once."""
+
+    def __init__(self, group: ProcessGroup):
+        self.group = group
+        self.works: List[Work] = []
+
+    def append(self, work: Work) -> None:
+        self.works.append(work)
+
+    def wait(self) -> None:
+        for w in self.works:
+            w.wait()
+        self.works = []
+
+
+@_contextmanager
+def coalescing_manager(group=None, async_ops: bool = False):
+    """Batch a series of collectives and wait for them together (torch
+    `_coalescing_manager`, `distributed_c10d.py` coalescing context)."""
+    g = _resolve(group)
+    cm = _CoalescingManager(g)
+    try:
+        yield cm
+    finally:
+        # wait even on the error path so completion callbacks (flight
+        # recorder / status) fire and nothing reads as forever-enqueued
+        if not async_ops:
+            cm.wait()
 
 
 # ---------------------------------------------------------------------------

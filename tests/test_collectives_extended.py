@@ -1,0 +1,105 @@
+"""Extended c10d surface: tensor-form collectives, group split/shrink,
+gather_object, coalescing manager (SURVEY.md §2.1 P1 rows :4404, :4996,
+:5517, :6368)."""
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+
+
+class TestTensorFormCollectives:
+    def test_all_gather_into_tensor(self, world, world_size):
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.full((2,), float(r), np.float32)
+        )
+        out = tdx.all_gather_into_tensor(t)
+        # per-rank value: concatenated (W*2,)
+        assert out.shape == (world_size * 2,)
+        want = np.repeat(np.arange(world_size, dtype=np.float32), 2)
+        np.testing.assert_array_equal(out.rank_local(0), want)
+        np.testing.assert_array_equal(out.rank_local(world_size - 1), want)
+
+    def test_all_to_all_single(self, world, world_size):
+        W = world_size
+        # rank r sends chunk [r*W + j] to rank j
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.arange(W, dtype=np.float32) + r * W
+        )
+        out = tdx.all_to_all_single(t)
+        for r in range(W):
+            want = np.asarray([s * W + r for s in range(W)], np.float32)
+            np.testing.assert_array_equal(out.rank_local(r), want)
+
+    def test_all_to_all_single_bad_split(self, world, world_size):
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.zeros((world_size + 1,), np.float32)
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            tdx.all_to_all_single(t)
+
+    def test_reduce_scatter_tensor(self, world, world_size):
+        W = world_size
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.ones((W * 3,), np.float32) * (r + 1)
+        )
+        out = tdx.reduce_scatter_tensor(t)
+        total = sum(range(1, W + 1))
+        for r in range(W):
+            np.testing.assert_allclose(
+                out.rank_local(r).reshape(-1), np.full((3,), total, np.float32)
+            )
+
+
+class TestGroupSplitShrink:
+    def test_split_group_disjoint(self, world, world_size):
+        W = world_size
+        half = W // 2
+        g = tdx.split_group(split_ranks=[list(range(half)), list(range(half, W))])
+        assert g is not None
+        assert g.size() in (half, W - half)
+        # collectives work within the split
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.array([1.0], np.float32), group=g
+        )
+        tdx.all_reduce(t, group=g)
+        assert float(t.numpy()[0, 0]) == g.size()
+
+    def test_split_group_overlap_rejected(self, world):
+        with pytest.raises(ValueError, match="more than one"):
+            tdx.split_group(split_ranks=[[0, 1], [1, 2]])
+
+    def test_shrink_subgroup(self, world, world_size):
+        g = tdx.new_group(range(world_size))
+        g2 = tdx.shrink_group([0], group=g)
+        assert g2.ranks == list(range(1, world_size))
+        t = tdx.DistTensor.from_rank_fn(
+            lambda r: np.array([1.0], np.float32), group=g2
+        )
+        tdx.all_reduce(t, group=g2)
+        assert float(t.numpy()[0, 0]) == world_size - 1
+
+
+class TestObjectsAndCoalescing:
+    def test_gather_object(self, world, world_size):
+        objs = [{"rank": r} for r in range(world_size)]
+        out: list = []
+        tdx.gather_object(objs, out)
+        assert out == objs
+
+    def test_rank_translation(self, world, world_size):
+        g = tdx.new_group(range(1, world_size))
+        assert tdx.get_group_rank(g, 1) == 0
+        assert tdx.get_global_rank(g, 0) == 1
+
+    def test_coalescing_manager(self, world, world_size):
+        t1 = tdx.DistTensor.from_rank_fn(lambda r: np.array([float(r)], np.float32))
+        t2 = tdx.DistTensor.from_rank_fn(lambda r: np.array([2.0 * r], np.float32))
+        with tdx.coalescing_manager() as cm:
+            w1 = tdx.all_reduce(t1, async_op=True)
+            w2 = tdx.all_reduce(t2, async_op=True)
+            cm.append(w1)
+            cm.append(w2)
+        s = sum(range(world_size))
+        assert float(t1.numpy()[0, 0]) == s
+        assert float(t2.numpy()[0, 0]) == 2 * s
