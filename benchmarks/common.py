@@ -1,25 +1,8 @@
-"""Shared benchmark harness helpers (JSON-line emission, timing)."""
+"""Shared benchmark harness helpers (JSON-line emission)."""
 
 from __future__ import annotations
 
 import json
-import time
-from typing import Callable
-
-
-def time_fn(fn: Callable, warmup: int, steps: int) -> float:
-    """Median-free simple wall-clock: total seconds for `steps` calls."""
-    import jax
-
-    out = None
-    for _ in range(warmup):
-        out = fn()
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn()
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0, **extra):

@@ -22,6 +22,7 @@ def main():
     ap.add_argument("--warmup", type=int, default=10)
     ap.add_argument("--bf16", action="store_true")
     args = ap.parse_args()
+    args.warmup = max(1, args.warmup)  # >=1: compile must precede timing
 
     import jax
     import jax.numpy as jnp

@@ -43,6 +43,7 @@ def main():
     ap.add_argument("--no-flash", action="store_true")
     ap.add_argument("--remat", action="store_true")
     args = ap.parse_args()
+    args.warmup = max(1, args.warmup)  # >=1: compile must precede timing
 
     import jax
     import jax.numpy as jnp

@@ -80,6 +80,50 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_double,
             ctypes.POINTER(ctypes.c_long),
         ]
+        # reducer core (csrc/reducer.cpp)
+        PF = ctypes.POINTER(ctypes.c_float)
+        lib.tdx_pack_f32.argtypes = [
+            ctypes.POINTER(PF),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            PF,
+        ]
+        lib.tdx_unpack_f32.argtypes = [
+            PF,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(PF),
+        ]
+        lib.tdx_count_nonfinite_f32.restype = ctypes.c_int64
+        lib.tdx_count_nonfinite_f32.argtypes = [PF, ctypes.c_int64]
+        # flight recorder (csrc/flight_recorder.cpp)
+        lib.tdx_fr_create.restype = ctypes.c_void_p
+        lib.tdx_fr_create.argtypes = [ctypes.c_int64]
+        lib.tdx_fr_destroy.argtypes = [ctypes.c_void_p]
+        lib.tdx_fr_record.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_double,
+        ]
+        lib.tdx_fr_complete.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_double,
+        ]
+        lib.tdx_fr_size.restype = ctypes.c_int64
+        lib.tdx_fr_size.argtypes = [ctypes.c_void_p]
+        # POINTER(c_char), not c_char_p: we must keep the raw pointer to
+        # free it after copying (heap-allocated per dump; see .cpp)
+        lib.tdx_fr_dump_json.restype = ctypes.POINTER(ctypes.c_char)
+        lib.tdx_fr_dump_json.argtypes = [ctypes.c_void_p]
+        lib.tdx_fr_dump_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
         _lib = lib
         return _lib
 
@@ -102,3 +146,107 @@ def compute_buckets(sizes, cap_bytes: float, first_cap_bytes: float):
     for i in range(n):
         buckets[out[i]].append(i)
     return buckets
+
+
+def _f32_ptr(a):
+    import numpy as np
+
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def pack_f32(leaves):
+    """Concatenate 1-D float32 numpy arrays into one flat buffer (native
+    multithreaded memcpy); returns the flat array or None w/o native."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    n = len(leaves)
+    leaves = [np.ascontiguousarray(l, dtype=np.float32).reshape(-1) for l in leaves]
+    lengths = (ctypes.c_int64 * n)(*[l.size for l in leaves])
+    srcs = (ctypes.POINTER(ctypes.c_float) * n)(*[_f32_ptr(l) for l in leaves])
+    total = sum(l.size for l in leaves)
+    out = np.empty((total,), np.float32)
+    lib.tdx_pack_f32(srcs, lengths, n, _f32_ptr(out))
+    return out
+
+
+def unpack_f32(flat, shapes):
+    """Split a flat float32 buffer back into arrays of the given shapes."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=np.float32).reshape(-1)
+    n = len(shapes)
+    sizes = [int(np.prod(s)) for s in shapes]  # () -> 1, (0,) -> 0
+    outs = [np.empty((sz,), np.float32) for sz in sizes]
+    lengths = (ctypes.c_int64 * n)(*sizes)
+    dsts = (ctypes.POINTER(ctypes.c_float) * n)(*[_f32_ptr(o) for o in outs])
+    lib.tdx_unpack_f32(_f32_ptr(flat), lengths, n, dsts)
+    return [o.reshape(s) for o, s in zip(outs, shapes)]
+
+
+def count_nonfinite_f32(arr) -> Optional[int]:
+    """Native NaN/Inf count over a float32 array; None w/o native."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    return int(lib.tdx_count_nonfinite_f32(_f32_ptr(a), a.size))
+
+
+class NativeFlightRecorder:
+    """ctypes handle over the C++ ring buffer (csrc/flight_recorder.cpp)."""
+
+    def __init__(self, capacity: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.tdx_fr_create(int(capacity))
+
+    def record(self, seq, op, group, shape, dtype, numel, ts):
+        self._lib.tdx_fr_record(
+            self._h,
+            int(seq),
+            str(op).encode(),
+            str(group).encode(),
+            str(tuple(shape)).encode(),
+            str(dtype).encode(),
+            int(numel),
+            float(ts),
+        )
+
+    def complete(self, seq, group, failed, ts):
+        self._lib.tdx_fr_complete(
+            self._h, int(seq), str(group).encode(), 1 if failed else 0, float(ts)
+        )
+
+    def size(self) -> int:
+        return int(self._lib.tdx_fr_size(self._h))
+
+    def dump_entries(self):
+        import json
+
+        ptr = self._lib.tdx_fr_dump_json(self._h)
+        try:
+            raw = ctypes.string_at(ptr)
+        finally:
+            self._lib.tdx_fr_dump_free(ptr)
+        return json.loads(raw.decode())
+
+    def close(self):
+        if self._h:
+            self._lib.tdx_fr_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

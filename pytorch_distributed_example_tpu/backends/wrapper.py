@@ -46,6 +46,44 @@ class ProcessGroupWrapper(Backend):
         self.driver_mode = driver_mode
         self._check_seq = 0
 
+    # -- NaN audit (torch NanCheck.hpp / TORCH_NCCL_NAN_CHECK parity) ------
+    def _nan_check(self, op: str, x) -> None:
+        """When TDX_NAN_CHECK=1, refuse to communicate non-finite data —
+        the debug-mode input audit the NCCL backend runs before each
+        collective (ProcessGroupNCCL.hpp:147). Native scan when libtdx is
+        available, numpy otherwise."""
+        import os
+
+        if os.environ.get("TDX_NAN_CHECK", "0") != "1" or x is None:
+            return
+        import numpy as np
+
+        try:
+            host = np.asarray(x)
+        except Exception:
+            return
+        name = host.dtype.name
+        if name == "float64":
+            # scan at full precision: a downcast would overflow large finite
+            # f64 values to inf and false-positive
+            bad = int((~np.isfinite(host)).sum())
+        elif name in ("float32", "float16", "bfloat16"):
+            # f16/bf16 upcast losslessly into f32 (bf16 shares the f32
+            # exponent range); np.issubdtype misses ml_dtypes.bfloat16,
+            # hence the name check
+            host32 = host if name == "float32" else host.astype(np.float32)
+            from .. import _native
+
+            bad = _native.count_nonfinite_f32(host32)
+            if bad is None:
+                bad = int((~np.isfinite(host32)).sum())
+        else:
+            return  # integer/bool payloads cannot be non-finite
+        if bad:
+            raise FloatingPointError(
+                f"nan check: {op} input contains {bad} non-finite value(s)"
+            )
+
     # -- the consistency check --------------------------------------------
     def _fingerprint(self, op: str, x) -> str:
         shape = tuple(getattr(x, "shape", ()))
@@ -54,6 +92,7 @@ class ProcessGroupWrapper(Backend):
 
     def _verify(self, op: str, x) -> None:
         self._check_seq += 1
+        self._nan_check(op, x)
         fp = self._fingerprint(op, x)
         if self.store is None:
             return

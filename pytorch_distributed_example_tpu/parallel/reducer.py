@@ -68,6 +68,34 @@ def compute_bucket_assignment_by_size(
     return buckets
 
 
+def flatten_host_bucket(leaves: Sequence[np.ndarray]) -> np.ndarray:
+    """Flatten host (numpy) gradient leaves into one f32 buffer — the
+    native-memcpy half of torch's flat `Bucket.gradients` (reducer.hpp:362)
+    for the eager/DLPack interop path. Falls back to np.concatenate."""
+    from .. import _native
+
+    out = _native.pack_f32([np.asarray(l, np.float32) for l in leaves])
+    if out is not None:
+        return out
+    return np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
+
+
+def unflatten_host_bucket(flat: np.ndarray, shapes: Sequence[Tuple[int, ...]]) -> List[np.ndarray]:
+    """Inverse of `flatten_host_bucket` (torch bucket_views_out scatter)."""
+    from .. import _native
+
+    out = _native.unpack_f32(flat, [tuple(s) for s in shapes])
+    if out is not None:
+        return out
+    res, off = [], 0
+    flat = np.asarray(flat, np.float32).reshape(-1)
+    for s in shapes:
+        n = int(np.prod(s))  # () -> 1, zero-size shapes -> 0
+        res.append(flat[off : off + n].reshape(s))
+        off += n
+    return res
+
+
 @dataclass
 class Bucket:
     """Flat bucket of gradient leaves — torch `Bucket` (reducer.hpp:356)."""
@@ -119,7 +147,7 @@ class Reducer:
         """Plan buckets over gradient leaves in REVERSED order (torch
         reverses params to approximate backward production order,
         distributed.py:1436-1438)."""
-        sizes = [int(np.prod(l.shape[1:]) or 1) * l.dtype.itemsize for l in leaves]
+        sizes = [int(np.prod(l.shape[1:])) * l.dtype.itemsize for l in leaves]
         order = list(range(len(leaves)))[::-1]
         assignment_rev = compute_bucket_assignment_by_size(
             [sizes[i] for i in order], self.bucket_cap_bytes, self.first_bucket_bytes
@@ -163,7 +191,7 @@ class Reducer:
         # runs while we flatten/dispatch bucket k+1)
         for idx_list in self._buckets_spec:
             shapes = [tuple(leaves[i].shape[1:]) for i in idx_list]
-            lengths = [int(np.prod(s) or 1) for s in shapes]
+            lengths = [int(np.prod(s)) for s in shapes]  # () -> 1, (0,) -> 0
             offsets = list(np.cumsum([0] + lengths[:-1]))
             flat = jnp.concatenate(
                 [leaves[i].reshape(W, -1) for i in idx_list], axis=1

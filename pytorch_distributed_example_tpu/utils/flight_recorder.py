@@ -37,7 +37,13 @@ class Entry:
 
 
 class FlightRecorder:
-    """Thread-safe ring buffer of collective records."""
+    """Ring buffer of collective records.
+
+    Backed by the native C++ ring (csrc/flight_recorder.cpp — the direct
+    N15 equivalent) when libtdx is loadable; otherwise a thread-safe
+    pure-Python deque. Stack capture (`record_stacks`) forces the Python
+    backend (stacks are a Python-side artifact).
+    """
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY, record_stacks: bool = False):
         self.capacity = capacity
@@ -45,8 +51,24 @@ class FlightRecorder:
         self._buf: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._by_seq: Dict[tuple, Entry] = {}
+        self._native = None
+        if not record_stacks and os.environ.get("TDX_FR_NATIVE", "1") == "1":
+            try:
+                from .. import _native
 
-    def record(self, seq: int, op: str, group: str, shape, dtype, numel: int) -> Entry:
+                if _native.available():
+                    self._native = _native.NativeFlightRecorder(capacity)
+            except Exception:
+                self._native = None
+
+    @property
+    def native(self) -> bool:
+        return self._native is not None
+
+    def record(self, seq: int, op: str, group: str, shape, dtype, numel: int) -> Optional[Entry]:
+        if self._native is not None:
+            self._native.record(seq, op, group, shape, dtype, numel, time.time())
+            return None
         stack: List[str] = []
         if self.record_stacks:
             stack = [
@@ -74,6 +96,9 @@ class FlightRecorder:
         return e
 
     def complete(self, seq: int, group: str, failed: bool = False) -> None:
+        if self._native is not None:
+            self._native.complete(seq, group, failed, time.time())
+            return
         with self._lock:
             e = self._by_seq.get((group, seq))
             if e is not None:
@@ -81,6 +106,23 @@ class FlightRecorder:
                 e.time_completed = time.time()
 
     def entries(self) -> List[Entry]:
+        if self._native is not None:
+            import ast
+
+            return [
+                Entry(
+                    seq=d["seq"],
+                    op=d["op"],
+                    group=d["group"],
+                    shape=ast.literal_eval(d["shape"]) if isinstance(d["shape"], str) else d["shape"],
+                    dtype=d["dtype"],
+                    numel=d["numel"],
+                    state=d["state"],
+                    time_created=d["time_created"],
+                    time_completed=d.get("time_completed"),
+                )
+                for d in self._native.dump_entries()
+            ]
         with self._lock:
             return list(self._buf)
 
@@ -89,6 +131,7 @@ class FlightRecorder:
             "version": SCHEMA_VERSION,
             "dumped_at": time.time(),
             "pid": os.getpid(),
+            "backend": "native" if self._native is not None else "python",
             "entries": [asdict(e) for e in self.entries()],
         }
 

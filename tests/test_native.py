@@ -1,0 +1,130 @@
+"""Native (C++ libtdx) component tests: reducer core, flight recorder,
+NaN audit. Each has a Python fallback; these tests pin the native paths.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu import _native
+
+
+requires_native = pytest.mark.skipif(
+    not _native.available(), reason="libtdx not built"
+)
+
+
+@requires_native
+class TestNativeReducerCore:
+    def test_pack_unpack_roundtrip(self):
+        gen = np.random.default_rng(0)
+        shapes = [(3, 4), (7,), (2, 2, 2), (1,)]
+        leaves = [gen.standard_normal(s).astype(np.float32) for s in shapes]
+        flat = _native.pack_f32(leaves)
+        assert flat.shape == (sum(int(np.prod(s)) for s in shapes),)
+        np.testing.assert_array_equal(
+            flat, np.concatenate([l.reshape(-1) for l in leaves])
+        )
+        back = _native.unpack_f32(flat, shapes)
+        for a, b in zip(back, leaves):
+            np.testing.assert_array_equal(a, b)
+
+    def test_pack_large_parallel_path(self):
+        # > 1M floats exercises the multithreaded chunk path
+        gen = np.random.default_rng(1)
+        big = gen.standard_normal((1 << 21,)).astype(np.float32)
+        flat = _native.pack_f32([big, big[:17]])
+        np.testing.assert_array_equal(flat[: big.size], big)
+
+    def test_count_nonfinite(self):
+        x = np.zeros((4096,), np.float32)
+        assert _native.count_nonfinite_f32(x) == 0
+        x[17] = np.nan
+        x[100] = np.inf
+        x[4000] = -np.inf
+        assert _native.count_nonfinite_f32(x) == 3
+
+
+@requires_native
+class TestNativeFlightRecorder:
+    def test_ring_and_dump(self):
+        fr = _native.NativeFlightRecorder(4)
+        for i in range(6):  # overflow a capacity-4 ring
+            fr.record(i, "all_reduce", "pg", (8, 8), "float32", 64, 100.0 + i)
+        fr.complete(4, "pg", False, 200.0)
+        fr.complete(5, "pg", True, 201.0)
+        assert fr.size() == 4
+        entries = fr.dump_entries()
+        assert [e["seq"] for e in entries] == [2, 3, 4, 5]
+        states = {e["seq"]: e["state"] for e in entries}
+        assert states[4] == "completed"
+        assert states[5] == "failed"
+        assert states[2] == "enqueued"
+        fr.close()
+
+    def test_python_recorder_uses_native(self):
+        from pytorch_distributed_example_tpu.utils.flight_recorder import (
+            FlightRecorder,
+        )
+
+        fr = FlightRecorder(capacity=8)
+        assert fr.native
+        fr.record(1, "broadcast", "g", (4,), "float32", 4)
+        fr.complete(1, "g")
+        es = fr.entries()
+        assert len(es) == 1 and es[0].state == "completed"
+        assert es[0].shape == (4,)
+        assert fr.dump()["backend"] == "native"
+
+
+class TestHostBucketHelpers:
+    def test_flatten_unflatten(self):
+        from pytorch_distributed_example_tpu.parallel.reducer import (
+            flatten_host_bucket,
+            unflatten_host_bucket,
+        )
+
+        gen = np.random.default_rng(2)
+        shapes = [(5, 5), (3,), (2, 4)]
+        leaves = [gen.standard_normal(s).astype(np.float32) for s in shapes]
+        flat = flatten_host_bucket(leaves)
+        back = unflatten_host_bucket(flat, shapes)
+        for a, b in zip(back, leaves):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestNanCheckWrapper:
+    def _wrapped(self, world):
+        import pytorch_distributed_example_tpu as tdx
+        from pytorch_distributed_example_tpu.backends.wrapper import (
+            ProcessGroupWrapper,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        g = tdx.distributed._get_default_group()
+        return ProcessGroupWrapper(
+            g.backend_impl,
+            HashStore(5.0),
+            my_rank=0,
+            world_size=world.size(),
+            driver_mode=True,
+        )
+
+    def test_nan_check_blocks_bad_collective(self, world, monkeypatch):
+        import pytorch_distributed_example_tpu as tdx
+        from pytorch_distributed_example_tpu.types import ReduceOp
+
+        monkeypatch.setenv("TDX_NAN_CHECK", "1")
+        w = self._wrapped(world)
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([np.nan], np.float32))
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            w.allreduce(t.array, ReduceOp.SUM)
+
+    def test_nan_check_off_by_default(self, world, monkeypatch):
+        import pytorch_distributed_example_tpu as tdx
+        from pytorch_distributed_example_tpu.types import ReduceOp
+
+        monkeypatch.delenv("TDX_NAN_CHECK", raising=False)
+        w = self._wrapped(world)
+        t = tdx.DistTensor.from_rank_fn(lambda r: np.array([np.nan], np.float32))
+        out, work = w.allreduce(t.array, ReduceOp.SUM)  # opt-in: no error
+        work.wait()
