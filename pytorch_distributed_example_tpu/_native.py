@@ -25,6 +25,15 @@ _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 _SO = os.path.join(_CSRC, "libtdx.so")
 
 
+def _make(force: bool = False) -> bool:
+    try:
+        cmd = ["make", "-C", _CSRC] + (["-B"] if force else [])
+        subprocess.run(cmd, capture_output=True, timeout=120, check=True)
+        return True
+    except Exception:
+        return False
+
+
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library, or None."""
     global _lib, _tried
@@ -34,98 +43,98 @@ def load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO):
-            try:
-                subprocess.run(
-                    ["make", "-C", _CSRC],
-                    capture_output=True,
-                    timeout=120,
-                    check=True,
-                )
-            except Exception:
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        if not os.path.exists(_SO) and not _make():
             return None
-        # signatures
-        lib.tdx_store_server_start.restype = ctypes.c_void_p
-        lib.tdx_store_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.tdx_store_server_port.restype = ctypes.c_int
-        lib.tdx_store_server_port.argtypes = [ctypes.c_void_p]
-        lib.tdx_store_server_stop.argtypes = [ctypes.c_void_p]
-        lib.tdx_store_client_connect.restype = ctypes.c_void_p
-        lib.tdx_store_client_connect.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int,
-            ctypes.c_double,
-        ]
-        lib.tdx_store_client_close.argtypes = [ctypes.c_void_p]
-        lib.tdx_store_client_call.restype = ctypes.c_long
-        lib.tdx_store_client_call.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int,
-            ctypes.c_char_p,
-            ctypes.c_long,
-            ctypes.c_char_p,
-            ctypes.c_long,
-        ]
-        lib.tdx_store_client_response.restype = ctypes.POINTER(ctypes.c_char)
-        lib.tdx_store_client_response.argtypes = [ctypes.c_void_p]
-        lib.tdx_compute_buckets.restype = ctypes.c_long
-        lib.tdx_compute_buckets.argtypes = [
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-            ctypes.c_double,
-            ctypes.c_double,
-            ctypes.POINTER(ctypes.c_long),
-        ]
-        # reducer core (csrc/reducer.cpp)
-        PF = ctypes.POINTER(ctypes.c_float)
-        lib.tdx_pack_f32.argtypes = [
-            ctypes.POINTER(PF),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
-            PF,
-        ]
-        lib.tdx_unpack_f32.argtypes = [
-            PF,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
-            ctypes.POINTER(PF),
-        ]
-        lib.tdx_count_nonfinite_f32.restype = ctypes.c_int64
-        lib.tdx_count_nonfinite_f32.argtypes = [PF, ctypes.c_int64]
-        # flight recorder (csrc/flight_recorder.cpp)
-        lib.tdx_fr_create.restype = ctypes.c_void_p
-        lib.tdx_fr_create.argtypes = [ctypes.c_int64]
-        lib.tdx_fr_destroy.argtypes = [ctypes.c_void_p]
-        lib.tdx_fr_record.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_double,
-        ]
-        lib.tdx_fr_complete.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_char_p,
-            ctypes.c_int,
-            ctypes.c_double,
-        ]
-        lib.tdx_fr_size.restype = ctypes.c_int64
-        lib.tdx_fr_size.argtypes = [ctypes.c_void_p]
-        # POINTER(c_char), not c_char_p: we must keep the raw pointer to
-        # free it after copying (heap-allocated per dump; see .cpp)
-        lib.tdx_fr_dump_json.restype = ctypes.POINTER(ctypes.c_char)
-        lib.tdx_fr_dump_json.argtypes = [ctypes.c_void_p]
-        lib.tdx_fr_dump_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
-        _lib = lib
-        return _lib
+        for attempt in (0, 1):
+            try:
+                _lib = _bind(ctypes.CDLL(_SO))
+                return _lib
+            except (OSError, AttributeError):
+                # stale .so missing newer symbols: force one rebuild
+                if attempt == 0 and _make(force=True):
+                    continue
+                _lib = None
+                return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare ctypes signatures; raises AttributeError on a stale library."""
+    lib.tdx_store_server_start.restype = ctypes.c_void_p
+    lib.tdx_store_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tdx_store_server_port.restype = ctypes.c_int
+    lib.tdx_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.tdx_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tdx_store_client_connect.restype = ctypes.c_void_p
+    lib.tdx_store_client_connect.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_double,
+    ]
+    lib.tdx_store_client_close.argtypes = [ctypes.c_void_p]
+    lib.tdx_store_client_call.restype = ctypes.c_long
+    lib.tdx_store_client_call.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_char_p,
+        ctypes.c_long,
+    ]
+    lib.tdx_store_client_response.restype = ctypes.POINTER(ctypes.c_char)
+    lib.tdx_store_client_response.argtypes = [ctypes.c_void_p]
+    lib.tdx_compute_buckets.restype = ctypes.c_long
+    lib.tdx_compute_buckets.argtypes = [
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    # reducer core (csrc/reducer.cpp)
+    PF = ctypes.POINTER(ctypes.c_float)
+    lib.tdx_pack_f32.argtypes = [
+        ctypes.POINTER(PF),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        PF,
+    ]
+    lib.tdx_unpack_f32.argtypes = [
+        PF,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(PF),
+    ]
+    lib.tdx_count_nonfinite_f32.restype = ctypes.c_int64
+    lib.tdx_count_nonfinite_f32.argtypes = [PF, ctypes.c_int64]
+    # flight recorder (csrc/flight_recorder.cpp)
+    lib.tdx_fr_create.restype = ctypes.c_void_p
+    lib.tdx_fr_create.argtypes = [ctypes.c_int64]
+    lib.tdx_fr_destroy.argtypes = [ctypes.c_void_p]
+    lib.tdx_fr_record.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_double,
+    ]
+    lib.tdx_fr_complete.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_double,
+    ]
+    lib.tdx_fr_size.restype = ctypes.c_int64
+    lib.tdx_fr_size.argtypes = [ctypes.c_void_p]
+    # POINTER(c_char), not c_char_p: we must keep the raw pointer to
+    # free it after copying (heap-allocated per dump; see .cpp)
+    lib.tdx_fr_dump_json.restype = ctypes.POINTER(ctypes.c_char)
+    lib.tdx_fr_dump_json.argtypes = [ctypes.c_void_p]
+    lib.tdx_fr_dump_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    return lib
 
 
 def available() -> bool:

@@ -9,6 +9,7 @@
 
 #include <cstdint>
 #include <cstdlib>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -38,10 +39,18 @@ struct Recorder {
 };
 
 void json_escape(std::ostringstream& os, const std::string& s) {
-  for (char c : s) {
-    if (c == '"' || c == '\\') os << '\\' << c;
-    else if (c == '\n') os << "\\n";
-    else os << c;
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') {
+      os << '\\' << c;
+    } else if (c == '\n') {
+      os << "\\n";
+    } else if (c < 0x20) {  // all control chars must be escaped in JSON
+      char buf[8];
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      os << buf;
+    } else {
+      os << c;
+    }
   }
 }
 
@@ -57,8 +66,9 @@ void tdx_fr_record(void* h, int64_t seq, const char* op, const char* group,
                    const char* shape, const char* dtype, int64_t numel,
                    double ts) {
   auto* r = static_cast<Recorder*>(h);
+  if (r->capacity <= 0) return;  // capacity 0 = recording disabled
   std::lock_guard<std::mutex> g(r->mu);
-  if (static_cast<int64_t>(r->ring.size()) >= r->capacity) {
+  while (static_cast<int64_t>(r->ring.size()) >= r->capacity) {
     r->ring.pop_front();
   }
   r->ring.push_back(Entry{seq, op, group, shape, dtype, numel, 0, ts, -1.0});
@@ -92,6 +102,7 @@ char* tdx_fr_dump_json(void* h) {
   std::lock_guard<std::mutex> g(r->mu);
   static const char* kState[] = {"enqueued", "completed", "failed"};
   std::ostringstream os;
+  os.precision(17);  // keep full epoch-second resolution for timestamps
   os << "[";
   bool first = true;
   for (const auto& e : r->ring) {

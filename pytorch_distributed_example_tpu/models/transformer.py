@@ -41,6 +41,8 @@ class TransformerConfig:
     dtype: Any = jnp.float32
     use_flash: bool = True
     remat: bool = False
+    n_experts: int = 0  # > 0 switches the MLP to a top-1 MoE (Switch-style)
+    moe_capacity_factor: float = 1.25
 
     @property
     def kv_heads(self) -> int:
@@ -147,6 +149,38 @@ class MLP(nn.Module):
         return dense(cfg.d_model, "down_proj")(nn.silu(gate) * up)
 
 
+class MoE(nn.Module):
+    """Top-1 MoE MLP (Switch) — experts shardable over an ``ep`` mesh axis
+    via `sharding_rules(ep_axis=...)`; routing math in
+    parallel/expert_parallel.moe_mlp (axis-free form here: under jit,
+    GSPMD partitions the expert einsums from the param shardings).
+    The load-balance aux loss is sown as intermediates/moe_aux."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.expert_parallel import moe_mlp
+
+        cfg = self.cfg
+        B, L, D = x.shape
+        E, F = cfg.n_experts, cfg.ffn_dim
+        init = nn.initializers.lecun_normal()
+        w_up = self.param("experts_up", init, (E, D, F))
+        w_down = self.param("experts_down", init, (E, F, D))
+        router = self.param("router", init, (D, E))
+        y, aux = moe_mlp(
+            x.reshape(B * L, D).astype(cfg.dtype),
+            w_up.astype(cfg.dtype),
+            w_down.astype(cfg.dtype),
+            router,
+            axis_name=None,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(B, L, D)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
@@ -154,7 +188,8 @@ class Block(nn.Module):
     def __call__(self, x, cos, sin):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin)
-        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        mlp_cls = MoE if cfg.n_experts > 0 else MLP
+        x = x + mlp_cls(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
         return x
 
 
@@ -180,21 +215,28 @@ class TransformerLM(nn.Module):
 
 
 def sharding_rules(
-    tp_axis: str = "tp", fsdp_axis: Optional[str] = "fsdp"
+    tp_axis: str = "tp",
+    fsdp_axis: Optional[str] = "fsdp",
+    ep_axis: Optional[str] = None,
 ) -> Sequence[Tuple[str, Tuple]]:
     """Canonical 2-D GSPMD layout for TransformerLM params.
 
     Megatron pairing: q/k/v/gate/up colwise over ``tp``; o/down rowwise
     over ``tp``; ZeRO dimension over ``fsdp`` on the complementary dim.
-    Set ``fsdp_axis=None`` for pure TP.
+    MoE expert stacks shard dim 0 over ``ep_axis`` (falls back to
+    ``fsdp_axis``). Set ``fsdp_axis=None`` for pure TP.
     """
     f = fsdp_axis
+    e = ep_axis or fsdp_axis
     return [
         (r"tok_embed/embedding", (None, tp_axis)),
         (r"(q_proj|k_proj|v_proj)/kernel", (f, tp_axis)),
         (r"o_proj/kernel", (tp_axis, f)),
         (r"(gate_proj|up_proj)/kernel", (f, tp_axis)),
         (r"down_proj/kernel", (tp_axis, f)),
+        (r"experts_up", (e, None, tp_axis)),
+        (r"experts_down", (e, tp_axis, None)),
+        (r"router", ()),
         (r"lm_head/kernel", (f, tp_axis)),
         (r"(attn_norm|mlp_norm|final_norm)/scale", (None,)),
         (r".*", ()),

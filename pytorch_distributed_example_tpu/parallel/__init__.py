@@ -15,6 +15,7 @@ from .context_parallel import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from .expert_parallel import make_ep_moe, moe_mlp  # noqa: F401
 from .pipeline import (  # noqa: F401
     make_pipeline_fn,
     merge_microbatches,

@@ -91,7 +91,9 @@ def unflatten_host_bucket(flat: np.ndarray, shapes: Sequence[Tuple[int, ...]]) -
     flat = np.asarray(flat, np.float32).reshape(-1)
     for s in shapes:
         n = int(np.prod(s))  # () -> 1, zero-size shapes -> 0
-        res.append(flat[off : off + n].reshape(s))
+        # copy: the native path returns fresh arrays; a view here would make
+        # in-place mutation alias the flat buffer only on non-native hosts
+        res.append(flat[off : off + n].reshape(s).copy())
         off += n
     return res
 

@@ -67,7 +67,9 @@ class FlightRecorder:
 
     def record(self, seq: int, op: str, group: str, shape, dtype, numel: int) -> Optional[Entry]:
         if self._native is not None:
-            self._native.record(seq, op, group, shape, dtype, numel, time.time())
+            self._native.record(
+                seq, op, group, tuple(int(s) for s in shape), dtype, numel, time.time()
+            )
             return None
         stack: List[str] = []
         if self.record_stacks:

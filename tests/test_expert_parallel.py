@@ -1,0 +1,162 @@
+"""Expert-parallel MoE tests: sharded dispatch/combine vs local reference,
+routing invariants, gradient flow, load-balance aux loss."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu.mesh import init_device_mesh
+from pytorch_distributed_example_tpu.parallel.expert_parallel import (
+    make_ep_moe,
+    moe_mlp,
+)
+
+
+def _setup(seed, T=64, D=16, E=8, F=32):
+    import jax.numpy as jnp
+
+    gen = np.random.default_rng(seed)
+    x = jnp.asarray(gen.standard_normal((T, D)), jnp.float32)
+    w_up = jnp.asarray(gen.standard_normal((E, D, F)) * 0.1, jnp.float32)
+    w_down = jnp.asarray(gen.standard_normal((E, F, D)) * 0.1, jnp.float32)
+    router = jnp.asarray(gen.standard_normal((D, E)) * 0.5, jnp.float32)
+    return x, w_up, w_down, router
+
+
+class TestMoELocal:
+    def test_output_shape_and_gate_weighting(self):
+        x, w_up, w_down, router = _setup(0)
+        y, aux = moe_mlp(x, w_up, w_down, router, axis_name=None)
+        assert y.shape == x.shape
+        assert float(aux) > 0
+
+    def test_every_kept_token_processed_by_argmax_expert(self):
+        """With capacity >= T every token goes through its top expert."""
+        import jax
+        import jax.numpy as jnp
+
+        x, w_up, w_down, router = _setup(1, T=16, E=4)
+        y, _ = moe_mlp(x, w_up, w_down, router, axis_name=None, capacity_factor=16.0)
+        probs = jax.nn.softmax(x @ router, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        want = jnp.stack(
+            [
+                gate[t] * (jax.nn.gelu(x[t] @ w_up[e]) @ w_down[e])
+                for t, e in enumerate(np.asarray(expert))
+            ]
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+class TestMoETransformer:
+    def test_moe_transformer_trains(self):
+        """TransformerLM with n_experts>0: forward shape, aux sown, loss falls,
+        and the ep-sharded GSPMD layout places expert stacks over the axis."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from pytorch_distributed_example_tpu.models import (
+            TransformerConfig,
+            TransformerLM,
+            transformer_sharding_rules,
+        )
+        from pytorch_distributed_example_tpu.parallel import sharding as shd
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_experts=4,
+            use_flash=False,
+        )
+        model = TransformerLM(cfg)
+        toks = jnp.asarray(np.random.default_rng(7).integers(0, 64, (4, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)
+        logits, state = model.apply(params, toks, mutable=["intermediates"])
+        assert logits.shape == (4, 16, 64)
+        aux = jax.tree_util.tree_leaves(state["intermediates"])
+        assert len(aux) == cfg.n_layers and all(float(a) > 0 for a in aux)
+
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                lg, st = model.apply(p, toks, mutable=["intermediates"])
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    lg[:, :-1], toks[:, 1:]
+                ).mean()
+                aux = sum(
+                    jnp.asarray(a).sum()
+                    for a in jax.tree_util.tree_leaves(st["intermediates"])
+                )
+                return ce + 0.01 * aux
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+        # ep-sharded layout: expert stacks split over the ep axis
+        mesh = init_device_mesh(("ep", "tp"), (4, 2))
+        sharded, specs = shd.shard_params(
+            params, mesh, transformer_sharding_rules("tp", None, ep_axis="ep")
+        )
+        wu = sharded["params"]["layers_0"]["mlp"]["experts_up"]
+        assert {s.data.shape[0] for s in wu.addressable_shards} == {1}  # 4/4
+
+
+class TestMoESharded:
+    def test_ep_sharded_matches_local(self):
+        """all_to_all dispatch over 8-way ep == all-experts-local compute.
+
+        Capacity semantics differ (per-source-rank vs global buffers), so
+        use a capacity factor big enough that nothing drops either way.
+        """
+        import jax
+
+        mesh = init_device_mesh(("ep",), (8,))
+        T, E = 64, 8
+        x, w_up, w_down, router = _setup(2, T=T, E=E)
+        want, aux_want = moe_mlp(
+            x, w_up, w_down, router, axis_name=None, capacity_factor=float(E)
+        )
+        ep_fn = make_ep_moe(mesh, "ep", capacity_factor=float(E))
+        got, aux_got = ep_fn(x, w_up, w_down, router)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+        # aux is a pmean of per-shard Switch losses; same order of magnitude
+        assert np.isfinite(float(aux_got))
+
+    def test_gradients_flow_through_dispatch(self):
+        import jax
+
+        mesh = init_device_mesh(("ep",), (8,))
+        x, w_up, w_down, router = _setup(3)
+        ep_fn = make_ep_moe(mesh, "ep", capacity_factor=8.0)
+
+        def loss(w_up, w_down, router):
+            y, aux = ep_fn(x, w_up, w_down, router)
+            return (y * y).sum() + 0.01 * aux
+
+        g_up, g_down, g_router = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
+            w_up, w_down, router
+        )
+        for g, name in [(g_up, "w_up"), (g_down, "w_down"), (g_router, "router")]:
+            arr = np.asarray(g)
+            assert np.isfinite(arr).all(), name
+            assert np.abs(arr).sum() > 0, name
+
+    def test_capacity_drops_tokens(self):
+        """Tiny capacity must produce zero output rows for dropped tokens."""
+        import jax.numpy as jnp
+
+        x, w_up, w_down, router = _setup(4, T=32, E=4)
+        y_full, _ = moe_mlp(x, w_up, w_down, router, axis_name=None, capacity_factor=32.0)
+        y_tight, _ = moe_mlp(x, w_up, w_down, router, axis_name=None, capacity_factor=0.25)
+        # tight capacity zeroes some rows that full capacity filled
+        zero_rows = (np.abs(np.asarray(y_tight)).sum(axis=1) == 0).sum()
+        assert zero_rows > 0
+        assert (np.abs(np.asarray(y_full)).sum(axis=1) == 0).sum() < zero_rows
