@@ -46,11 +46,22 @@ def load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SO) and not _make():
             return None
         for attempt in (0, 1):
+            lib = None
             try:
-                _lib = _bind(ctypes.CDLL(_SO))
+                lib = ctypes.CDLL(_SO)
+                _lib = _bind(lib)
                 return _lib
             except (OSError, AttributeError):
-                # stale .so missing newer symbols: force one rebuild
+                # stale .so missing newer symbols: dlclose the mapped copy
+                # (else re-dlopen returns the same stale mapping) and force
+                # one rebuild
+                if lib is not None:
+                    try:
+                        import _ctypes
+
+                        _ctypes.dlclose(lib._handle)
+                    except Exception:
+                        pass
                 if attempt == 0 and _make(force=True):
                     continue
                 _lib = None

@@ -14,11 +14,12 @@ follows the standard top-k token-choice recipe (Switch/GShard family):
 * everything is differentiable; router uses softmax gating with the
   load-balancing auxiliary loss from the Switch Transformer.
 
-Two entry points:
+Entry points:
   * `moe_mlp(...)` — plain function usable inside any shard_map over an
     ``ep`` axis (what `dryrun_multichip` and the tests exercise);
-  * `MoEMLP` — flax module wrapping the same math for TransformerLM
-    (replicated-expert fallback when no mesh axis is in scope).
+  * `make_ep_moe(mesh, ...)` — jit-ready sharded wrapper;
+  * the flax module form lives in `models/transformer.py` (`MoE`), wired
+    in via `TransformerConfig(n_experts > 0)`.
 """
 
 from __future__ import annotations

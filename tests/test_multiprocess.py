@@ -1,0 +1,137 @@
+"""Multi-process tests — the MultiProcessTestCase analog (SURVEY.md §4.1/§4b).
+
+Spawns real OS processes; each pins the CPU platform, joins
+`jax.distributed` (the multi-host coordination service), rendezvous through
+the framework's TCPStore via `init_process_group(init_method='tcp://...')`
+— exactly the reference's multi-host bring-up path (rank 0 hosts the store,
+others connect) — then runs a cross-process psum over the global mesh.
+
+This is the only place multiproc mode (process_rank = jax.process_index())
+is exercised end to end; everything else runs driver mode.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{jport}",
+        num_processes=world,
+        process_id=rank,
+    )
+    assert jax.process_count() == world, jax.process_count()
+    assert len(jax.devices()) == world  # global device view
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import pytorch_distributed_example_tpu as tdx
+
+    pg = tdx.init_process_group(
+        backend="xla",
+        init_method=f"tcp://127.0.0.1:{sport}",
+        rank=rank,
+        world_size=world,
+    )
+    assert tdx.distributed._world.mode == "multiproc"
+    assert tdx.get_rank() == rank, (tdx.get_rank(), rank)
+    assert tdx.get_world_size() == world
+
+    # control-plane: cross-process store traffic
+    pg.store.set(f"hello/{rank}", str(rank).encode())
+    pg.store.wait([f"hello/{r}" for r in range(world)], 30.0)
+    got = [int(pg.store.get(f"hello/{r}")) for r in range(world)]
+    assert got == list(range(world)), got
+
+    # data-plane: psum over the global mesh (each process contributes its
+    # rank+1 from its local device)
+    mesh = pg.mesh.jax_mesh
+    local = jnp.full((1, 1), float(rank + 1), jnp.float32)
+    garr = jax.make_array_from_single_device_arrays(
+        (world, 1),
+        NamedSharding(mesh, P("_ranks")),
+        [jax.device_put(local, jax.local_devices()[0])],
+    )
+    from pytorch_distributed_example_tpu._compat import shard_map_fn
+    from jax import lax
+
+    f = jax.jit(
+        shard_map_fn(
+            lambda x: lax.psum(x, "_ranks"),
+            mesh=mesh,
+            in_specs=P("_ranks"),
+            out_specs=P(),
+        )
+    )
+    out = f(garr)
+    total = float(np.asarray(jax.device_get(out))[0, 0])
+    expect = world * (world + 1) / 2
+    assert total == expect, (total, expect)
+
+    # monitored_barrier exercises the per-rank arrival keys in multiproc
+    tdx.monitored_barrier()
+
+    tdx.destroy_process_group()
+    print(f"worker {rank}: OK {total}")
+    """
+)
+
+
+@pytest.mark.parametrize("world", [2])
+def test_multiprocess_bringup_and_psum(tmp_path, world):
+    jport, sport = _free_port(), _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # children must not inherit pytest's XLA_FLAGS device-count override:
+    # each process brings exactly one CPU device to the global mesh
+    env["XLA_FLAGS"] = ""
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), str(jport), str(sport)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=REPO,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multiprocess workers timed out:\n" + "\n".join(outs))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"worker {r}: OK" in out
