@@ -269,7 +269,18 @@ def init_process_group(
     backend = (backend or "xla").lower()
     tsec = _timeout_seconds(timeout)
 
-    multiproc = jax.process_count() > 1
+    try:
+        multiproc = jax.process_count() > 1
+    except Exception as e:
+        # First backend touch in many programs lands here; surface an
+        # actionable message instead of the raw PJRT plugin trace
+        # (round-1 BENCH died on exactly this, bench.py now retries).
+        raise RuntimeError(
+            "init_process_group: JAX backend initialization failed "
+            f"({type(e).__name__}: {e}). If the TPU plugin is unavailable, "
+            "set JAX_PLATFORMS=cpu (optionally with XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N) and retry."
+        ) from e
     if multiproc:
         _world.mode = "multiproc"
         _world.process_rank = jax.process_index()
