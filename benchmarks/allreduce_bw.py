@@ -1,10 +1,14 @@
 """Collective micro-benchmark — BASELINE.json config #2.
 
-all_reduce / broadcast over the world group, tensor sizes 1KB - 1GB
-(cap configurable; default 256MB to stay inside one chip's HBM headroom
-alongside double-buffering). Reports algorithm bandwidth (payload/time)
-and bus bandwidth (ring-traffic model: allreduce moves 2(W-1)/W bytes per
-byte of payload, broadcast (W-1)/W).
+all_reduce / broadcast / scatter / all_gather / reduce_scatter over the
+world group, tensor sizes 1KB - 1GB (cap configurable; default 256MB to
+stay inside one chip's HBM headroom alongside double-buffering). Reports
+algorithm bandwidth (payload/time) and bus bandwidth (ring-traffic model:
+allreduce moves 2(W-1)/W bytes per payload byte; one-to-all ops (W-1)/W).
+
+broadcast and scatter lower to source-masked psum (backends/xla.py), so
+their wire cost matches an allreduce — the acceptance check here is
+broadcast ~= allreduce bandwidth, not W x worse.
 
 Torch-reference equivalent: the gloo ring allreduce the reference's
 toy/main.py exercises (SURVEY.md §2.2 N8/N9). Here each collective is one
@@ -21,58 +25,84 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+OPS = ["all_reduce", "broadcast", "scatter", "all_gather", "reduce_scatter"]
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=256.0)
     ap.add_argument("--min-kb", type=float, default=1.0)
-    ap.add_argument("--op", choices=["all_reduce", "broadcast", "both"], default="both")
+    ap.add_argument("--op", choices=OPS + ["both", "all"], default="both")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=5)
     args = ap.parse_args()
 
-    import jax
     import numpy as np
 
     import pytorch_distributed_example_tpu as tdx
+
     from benchmarks.common import emit
 
     if not tdx.is_initialized():
         tdx.init_process_group(backend="xla")
     W = tdx.get_world_size()
 
-    ops = ["all_reduce", "broadcast"] if args.op == "both" else [args.op]
+    if args.op == "both":
+        ops = ["all_reduce", "broadcast"]
+    elif args.op == "all":
+        ops = OPS
+    else:
+        ops = [args.op]
+
     size = int(args.min_kb * 1024)
     max_size = int(args.max_mb * 1024 * 1024)
     results = []
     while size <= max_size:
         n = max(size // 4, 1)  # fp32 elements per rank
-        t = tdx.DistTensor.from_rank_fn(
+        flat = tdx.DistTensor.from_rank_fn(
             lambda r: np.full((n,), float(r), np.float32)
+        )
+        # chunk-list input for scatter / reduce_scatter: W rows of n/W elems
+        nc = max(n // W, 1)
+        rows = tdx.DistTensor.from_rank_fn(
+            lambda r: np.full((W, nc), float(r), np.float32)
         )
         for op in ops:
             if op == "all_reduce":
-                run = lambda: tdx.all_reduce(t)
+                run = lambda: (tdx.all_reduce(flat), flat)[1]
                 bus_factor = 2 * (W - 1) / W
-            else:
-                run = lambda: tdx.broadcast(t, 0)
+            elif op == "broadcast":
+                run = lambda: (tdx.broadcast(flat, 0), flat)[1]
                 bus_factor = (W - 1) / W
+            elif op == "scatter":
+                run = lambda: tdx.scatter(rows, 0)
+                bus_factor = (W - 1) / W
+            elif op == "all_gather":
+                run = lambda: tdx.all_gather(flat)
+                bus_factor = (W - 1) / W
+            else:  # reduce_scatter
+                run = lambda: tdx.reduce_scatter(rows)
+                bus_factor = (W - 1) / W
+            out = None
             for _ in range(args.warmup):
-                run()
-            t.block_until_ready()
+                out = run()
+            if out is None:  # --warmup 0: still need one compile pass
+                out = run()
+            out.block_until_ready()
             t0 = time.perf_counter()
             for _ in range(args.iters):
-                run()
-            t.block_until_ready()
+                out = run()
+            out.block_until_ready()
             dt = (time.perf_counter() - t0) / args.iters
-            algbw = size / dt / 1e9
+            payload = size if op in ("all_reduce", "broadcast", "all_gather") else nc * W * 4
+            algbw = payload / dt / 1e9
             results.append(
                 emit(
                     f"{op}_bw_{_fmt(size)}",
                     algbw,
                     "GB/s",
                     bus_bw=round(algbw * bus_factor, 3),
-                    bytes=size,
+                    bytes=payload,
                     world=W,
                     us=round(dt * 1e6, 1),
                 )

@@ -135,3 +135,209 @@ def test_multiprocess_bringup_and_psum(tmp_path, world):
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"worker {r}: OK" in out
+
+
+COLLECTIVES_WORKER = textwrap.dedent(
+    """
+    import sys
+    rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{jport}",
+        num_processes=world,
+        process_id=rank,
+    )
+
+    import numpy as np
+    import pytorch_distributed_example_tpu as tdx
+
+    pg = tdx.init_process_group(
+        backend="xla",
+        init_method=f"tcp://127.0.0.1:{sport}",
+        rank=rank,
+        world_size=world,
+    )
+
+    # --- the c10d collective surface, through the tdx API, cross-process ---
+    # (round-1 gap: only raw shard_map psum was exercised in multiproc)
+
+    # 1. all_reduce
+    t = tdx.DistTensor.from_process_local(np.array([rank + 1.0], np.float32))
+    tdx.all_reduce(t)
+    assert t.local_numpy()[0][0] == world * (world + 1) / 2, t.local_numpy()
+
+    # 2. broadcast (src=0)
+    t = tdx.DistTensor.from_process_local(np.array([float(rank)], np.float32))
+    tdx.broadcast(t, 0)
+    assert t.local_numpy()[0][0] == 0.0
+
+    # 3. all_gather
+    t = tdx.DistTensor.from_process_local(np.array([float(rank)], np.float32))
+    g = tdx.all_gather(t)
+    got = [float(v) for v in g.local_numpy()[0][:, 0]]
+    assert got == [float(r) for r in range(world)], got
+
+    # 4. reduce_scatter (SUM): every rank contributes rows of (rank+1)
+    rows = tdx.DistTensor.from_process_local(
+        np.full((world, 2), float(rank + 1), np.float32)
+    )
+    rs = tdx.reduce_scatter(rows)
+    assert rs.local_numpy()[0][0] == world * (world + 1) / 2
+
+    # 5. scatter (src=0): row r of rank 0's list goes to rank r
+    rows = tdx.DistTensor.from_process_local(
+        (np.arange(world, dtype=np.float32) * (rank + 1)).reshape(world, 1)
+    )
+    sc = tdx.scatter(rows, 0)
+    assert sc.local_numpy()[0][0] == float(rank), sc.local_numpy()
+
+    # 6. barrier + monitored_barrier twice with interleaved traffic
+    # (regression: round-1 keyed arrival on the backend sequence number,
+    # which can disagree across ranks -> spurious deadlock)
+    tdx.barrier()
+    tdx.monitored_barrier()
+    t2 = tdx.DistTensor.from_process_local(np.ones((3,), np.float32))
+    tdx.all_reduce(t2)
+    tdx.monitored_barrier()
+
+    # --- DDP: divergent init must become identical after wrap -------------
+    import hashlib
+    import jax.numpy as jnp
+    import optax
+    from pytorch_distributed_example_tpu.models import ConvNet
+
+    model = ConvNet()
+    params = model.init(jax.random.PRNGKey(rank), jnp.zeros((1, 28, 28, 1)))
+
+    def tree_hash(tree):
+        leaves = jax.tree_util.tree_leaves(jax.device_get(tree))
+        h = hashlib.sha256()
+        for l in leaves:
+            h.update(np.ascontiguousarray(np.asarray(l, np.float32)).tobytes())
+        return h.hexdigest()
+
+    pre = tree_hash(params)
+    pg.store.set(f"pre/{rank}", pre.encode())
+    pg.store.wait([f"pre/{r}" for r in range(world)], 60.0)
+    pres = {pg.store.get(f"pre/{r}").decode() for r in range(world)}
+    assert len(pres) == world, "divergent init expected"
+
+    ddp = tdx.DistributedDataParallel(model, params)
+    post = tree_hash(ddp.params)
+    pg.store.set(f"post/{rank}", post.encode())
+    pg.store.wait([f"post/{r}" for r in range(world)], 60.0)
+    posts = {pg.store.get(f"post/{r}").decode() for r in range(world)}
+    assert len(posts) == 1, f"replicas diverged after wrap: {posts}"
+    assert post == pg.store.get("post/0").decode()
+
+    # one identical train step on the synced replicas
+    opt = optax.sgd(0.05)
+    step = ddp.make_train_step(opt, lambda lg, y: optax.
+        softmax_cross_entropy_with_integer_labels(lg, y).mean())
+    gen = np.random.default_rng(0)  # same global batch on every process
+    x = gen.standard_normal((2 * world, 28, 28, 1)).astype(np.float32)
+    y = gen.integers(0, 10, 2 * world).astype(np.int32)
+    p2, _, loss = step(ddp.params, opt.init(ddp.params), x, y)
+    stepped = tree_hash(p2)
+    pg.store.set(f"stepped/{rank}", stepped.encode())
+    pg.store.wait([f"stepped/{r}" for r in range(world)], 60.0)
+    step_hashes = {pg.store.get(f"stepped/{r}").decode() for r in range(world)}
+    assert len(step_hashes) == 1, f"ranks trained differently: {step_hashes}"
+
+    tdx.destroy_process_group()
+    print(f"worker {rank}: OK collectives+ddp")
+    """
+)
+
+
+MISMATCH_WORKER = textwrap.dedent(
+    """
+    import sys
+    rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{jport}",
+        num_processes=world,
+        process_id=rank,
+    )
+
+    import numpy as np
+    import pytorch_distributed_example_tpu as tdx
+
+    tdx.init_process_group(
+        backend="xla",
+        init_method=f"tcp://127.0.0.1:{sport}",
+        rank=rank,
+        world_size=world,
+    )
+
+    # rank 1's "conv" param has a different shape: the error must NAME it
+    shape = (3, 3) if rank == 0 else (3, 4)
+    params = {
+        "dense": {"kernel": np.zeros((4, 4), np.float32)},
+        "conv": {"kernel": np.zeros(shape, np.float32)},
+    }
+    try:
+        tdx.DistributedDataParallel(None, params)
+    except RuntimeError as e:
+        assert "conv" in str(e), f"param not named: {e}"
+        print(f"worker {rank}: OK mismatch named")
+    else:
+        raise AssertionError("shape mismatch not detected")
+    """
+)
+
+
+def _run_workers(tmp_path, script_body, world, timeout=240):
+    jport, sport = _free_port(), _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = ""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), str(jport), str(sport)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=REPO,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multiprocess workers timed out:\n" + "\n".join(outs))
+    return procs, outs
+
+
+@pytest.mark.parametrize("world", [2])
+def test_multiprocess_collective_surface_and_ddp_sync(tmp_path, world):
+    """>=6 tdx collectives + DDP divergent-init sync, across real processes
+    (round-1 VERDICT missing #2/#5, next-round items 3/4)."""
+    procs, outs = _run_workers(tmp_path, COLLECTIVES_WORKER, world)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"worker {r}: OK collectives+ddp" in out
+
+
+@pytest.mark.parametrize("world", [2])
+def test_multiprocess_param_shape_mismatch_named(tmp_path, world):
+    """Cross-rank shape mismatch must raise naming the offending param
+    (torch reducer.hpp:616 behavior)."""
+    procs, outs = _run_workers(tmp_path, MISMATCH_WORKER, world)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"worker {r}: OK mismatch named" in out
