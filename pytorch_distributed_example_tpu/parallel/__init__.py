@@ -2,6 +2,14 @@ from .ddp import DistributedDataParallel, make_ddp_train_step, make_eval_step  #
 from .reducer import Reducer, compute_bucket_assignment_by_size  # noqa: F401
 from .join import Join, Joinable, JoinHook, join_batches  # noqa: F401
 from . import comm_hooks  # noqa: F401
+from .comm_hooks import PowerSGDHook, powerSGD_hook  # noqa: F401
+from .localsgd import (  # noqa: F401
+    PeriodicModelAverager,
+    init_stacked_opt_state,
+    make_localsgd_train_step,
+    stack_replicas,
+    unstack_replicas,
+)
 from . import sharding  # noqa: F401
 from .fsdp import FSDPModule, fully_shard, make_fsdp_train_step, shard_optimizer_only  # noqa: F401
 from .tensor_parallel import (  # noqa: F401

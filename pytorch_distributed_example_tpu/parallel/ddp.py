@@ -223,6 +223,7 @@ def make_ddp_train_step(
     grad_accum_steps: int = 1,
     find_unused_parameters: bool = False,
     on_unused: Optional[Callable] = None,
+    logger=None,
 ):
     """Compile a data-parallel train step over the group's mesh.
 
@@ -253,8 +254,12 @@ def make_ddp_train_step(
     mesh = g.mesh.jax_mesh
     axis = g.mesh.axis_names[0]
     hook = comm_hook or comm_hooks.allreduce_hook
+    # Stateful hooks (PowerSGD: error feedback + warm-started Q) carry an
+    # explicit state pytree through the step — torch mutates PowerSGDState
+    # in place (`powerSGD_hook.py`); functional XLA threads it instead.
+    stateful_hook = hasattr(hook, "init") and hasattr(hook, "apply")
 
-    def local_step(params, opt_state, x, y, rng):
+    def local_step(params, opt_state, hook_state, x, y, rng):
         def objective(p, xm, ym, step_i):
             if has_rng:
                 # per-device, per-microbatch independent dropout streams
@@ -294,21 +299,30 @@ def make_ddp_train_step(
             (loss, aux), grads = jax.value_and_grad(obj, has_aux=True)(
                 params, x, y, 0
             )
-        grads = hook(grads, axis)
+        if stateful_hook:
+            # hook state is SHARDED over the dp axis (leading rank dim):
+            # PowerSGD's error-feedback residual diverges per device (each
+            # device compresses its own shard's gradient), so replicating
+            # it would silently drop every residual but one.
+            hs_local = jax.tree_util.tree_map(lambda l: l[0], hook_state)
+            grads, hs_local = hook.apply(hs_local, grads, axis)
+            hook_state = jax.tree_util.tree_map(lambda l: l[None], hs_local)
+        else:
+            grads = hook(grads, axis)
         loss = lax.pmean(loss, axis)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, loss, aux
+        return new_params, new_opt_state, hook_state, loss, aux
 
     sm = _shard_map()
     mapped = sm(
         local_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P(axis), P(), P()),
         check_vma=False,
     )
-    jitted = jax.jit(mapped, donate_argnums=(0, 1))
+    jitted = jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     unused_checked = [False]
 
@@ -345,11 +359,47 @@ def make_ddp_train_step(
                 "torch DDP's contract."
             )
 
-    if has_rng:
+    if stateful_hook:
+        # step carries the hook state: (params, opt_state, hook_state, ...)
+        if has_rng:
+
+            def step(params, opt_state, hook_state, x, y, rng):
+                _check_unused(params, x, rng)
+                p, o, hs, l, aux = jitted(params, opt_state, hook_state, x, y, rng)
+                return (p, o, hs, l, aux) if with_aux else (p, o, hs, l)
+
+        else:
+            _dummy = None
+
+            def step(params, opt_state, hook_state, x, y):
+                nonlocal _dummy
+                if _dummy is None:
+                    _dummy = jax.random.PRNGKey(0)
+                _check_unused(params, x, _dummy)
+                p, o, hs, l, aux = jitted(
+                    params, opt_state, hook_state, x, y, _dummy
+                )
+                return (p, o, hs, l, aux) if with_aux else (p, o, hs, l)
+
+        def init_hook_state(params):
+            """Rank-stacked hook state: every rank starts from the same
+            local state (same random Q so the psum'd projections are
+            coherent; zero error), then each rank's slice evolves
+            independently under the P(axis) sharding."""
+            import jax.numpy as jnp
+
+            local = hook.init(params)
+            W = g.size()
+            return jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l[None], (W,) + tuple(l.shape)), local
+            )
+
+        step.init_hook_state = init_hook_state
+    elif has_rng:
 
         def step(params, opt_state, x, y, rng):
             _check_unused(params, x, rng)
-            p, o, l, aux = jitted(params, opt_state, x, y, rng)
+            p, o, _, l, aux = jitted(params, opt_state, {}, x, y, rng)
             return (p, o, l, aux) if with_aux else (p, o, l)
 
     else:
@@ -360,8 +410,23 @@ def make_ddp_train_step(
             if _dummy is None:
                 _dummy = jax.random.PRNGKey(0)
             _check_unused(params, x, _dummy)
-            p, o, l, aux = jitted(params, opt_state, x, y, _dummy)
+            p, o, _, l, aux = jitted(params, opt_state, {}, x, y, _dummy)
             return (p, o, l, aux) if with_aux else (p, o, l)
+
+    if logger is not None:
+        inner = step
+
+        def step(*args, **kwargs):  # noqa: F811
+            if not logger.timing_enabled:
+                return inner(*args, **kwargs)
+            logger.step_begin()
+            out = inner(*args, **kwargs)
+            jax.block_until_ready(out)  # true wall time, not dispatch time
+            logger.step_end()
+            return out
+
+        if hasattr(inner, "init_hook_state"):
+            step.init_hook_state = inner.init_hook_state
 
     step.mesh = mesh
     step.axis = axis
@@ -464,13 +529,24 @@ class DistributedDataParallel:
 
         self.reducer = Reducer(process_group=g, bucket_cap_mb=bucket_cap_mb)
 
+        # (e) logger — torch `dist.Logger(reducer)` (`distributed.py:1462`)
+        from ..utils.logger import DDPLogger
+
+        self.logger = DDPLogger(self)
+
     # -- torch surface -----------------------------------------------------
     def __call__(self, x, *args, **kwargs):
         return self.module.apply(self.params, x, *args, **kwargs)
 
     def register_comm_hook(self, state, hook: Callable) -> None:
-        """torch `register_comm_hook` (`distributed.py:2178`); hook signature
-        here is `hook(grads, axis_name) -> reduced_grads`."""
+        """torch `register_comm_hook` (`distributed.py:2178`). Stateless
+        hooks: `hook(grads, axis_name) -> reduced_grads` (an optional
+        `state` is partial'd in front). Stateful hooks (PowerSGDHook):
+        pass the hook object; its pytree state is threaded through the
+        train step explicitly (see make_ddp_train_step)."""
+        if hasattr(hook, "init") and hasattr(hook, "apply"):
+            self._comm_hook = hook
+            return
         if state is not None:
             hook = functools.partial(hook, state)
         self._comm_hook = hook
@@ -506,6 +582,7 @@ class DistributedDataParallel:
         )
         kw.setdefault("find_unused_parameters", self.find_unused_parameters)
         kw.setdefault("on_unused", self.unused_parameter_names.extend)
+        kw.setdefault("logger", self.logger)
         return make_ddp_train_step(
             apply,
             loss_fn,
@@ -522,6 +599,119 @@ class DistributedDataParallel:
             metric_fn,
             group=self.process_group,
         )
+
+    def get_ddp_logging_data(self):
+        """torch `_get_ddp_logging_data` (`distributed.py:2552`)."""
+        return self.logger.get_ddp_logging_data()
+
+    def profile_breakdown(self, optimizer, loss_fn, x, y, iters: int = 5):
+        """Populate the logger's fwd/bwd/comm/opt component times.
+
+        Compiled-mode decomposition of torch's reducer timers
+        (`reducer.hpp:468-472`, `logger.hpp:85-90`): one fused XLA program
+        cannot be clocked mid-step from Python, so four prefix programs
+        are compiled and differenced — forward; forward+backward; full
+        step with reduction replaced by noop; full step. The differences
+        are the component walls (comm includes what XLA could NOT overlap,
+        which is the number that matters for tuning).
+        """
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        g = self.process_group
+        mesh = g.mesh.jax_mesh
+        axis = g.mesh.axis_names[0]
+        apply = lambda p, xa: self.module.apply(p, xa)
+        sm = _shard_map()
+
+        fwd = jax.jit(
+            sm(
+                apply,
+                mesh=mesh,
+                in_specs=(P(), P(axis)),
+                out_specs=P(axis),
+                check_vma=False,
+            )
+        )
+
+        def obj(p, xm, ym):
+            return loss_fn(apply(p, xm), ym)
+
+        fwdbwd = jax.jit(
+            sm(
+                lambda p, xm, ym: jax.value_and_grad(obj)(p, xm, ym),
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(axis)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+        nosync = make_ddp_train_step(
+            apply, loss_fn, optimizer, group=g, comm_hook=comm_hooks.noop_hook
+        )
+        full = make_ddp_train_step(
+            apply, loss_fn, optimizer, group=g, comm_hook=self._comm_hook
+        )
+
+        def clock(fn, *args):
+            out = None
+            for _ in range(2):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (_time.perf_counter() - t0) / iters
+
+        def clock_step(stepfn):
+            p = jax.tree_util.tree_map(jnp.copy, self.params)  # donation guard
+            o = optimizer.init(p)
+            hs = (
+                stepfn.init_hook_state(p)
+                if hasattr(stepfn, "init_hook_state")
+                else None
+            )
+
+            def one():
+                nonlocal p, o, hs
+                if hs is not None:
+                    p, o, hs, l = stepfn(p, o, hs, x, y)
+                else:
+                    p, o, l = stepfn(p, o, x, y)
+                return l
+
+            l = None
+            for _ in range(2):
+                l = one()
+            jax.block_until_ready(l)
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                l = one()
+            jax.block_until_ready(l)
+            return (_time.perf_counter() - t0) / iters
+
+        t_f = clock(fwd, self.params, x)
+        t_fb = clock(fwdbwd, self.params, x, y)
+        t_ns = clock_step(nosync)
+        t_full = clock_step(full)
+
+        lg = self.logger
+        lg.avg_forward_compute_time_s = t_f
+        lg.avg_backward_compute_time_s = max(t_fb - t_f, 0.0)
+        lg.avg_optimizer_time_s = max(t_ns - t_fb, 0.0)
+        lg.avg_backward_comm_time_s = max(t_full - t_ns, 0.0)
+        return {
+            "forward_s": lg.avg_forward_compute_time_s,
+            "backward_s": lg.avg_backward_compute_time_s,
+            "optimizer_s": lg.avg_optimizer_time_s,
+            "comm_exposed_s": lg.avg_backward_comm_time_s,
+            "full_step_s": t_full,
+        }
 
     def state_dict(self):
         import jax

@@ -1,0 +1,162 @@
+"""Post-local SGD: local steps with periodic model averaging.
+
+Parity surface: torch `distributed/algorithms/ddp_comm_hooks/
+post_localSGD_hook.py` (+ `model_averaging/averagers.py`
+PeriodicModelAverager) — SURVEY.md §2.1 P6. Torch's hook stops reducing
+gradients after `start_localSGD_iter` and a PeriodicModelAverager
+all-reduces the *parameters* every `period` steps.
+
+TPU-native shape: replicated `P()` params cannot diverge per device inside
+one SPMD program, so local SGD uses REPLICA-STACKED params — leading axis =
+dp rank, sharded `P(axis)` — and two compiled programs:
+
+* `local_step`: per-replica forward/backward/update, NO collective;
+* `average`: `pmean` of the stacked params across the axis.
+
+The Python-level trainer calls `average` every `period` steps (a
+data-dependent branch around a collective does not belong inside one XLA
+program). This is bitwise-faithful to torch's semantics: grads stay local,
+models drift, and the drift is reconciled by parameter averaging.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .._compat import shard_map_fn
+
+
+def stack_replicas(tree, world: int):
+    """Tile a param pytree to (world, *shape) leaves — one replica per rank."""
+    import jax.numpy as jnp
+
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(leaf[None], (world,) + tuple(leaf.shape)),
+        tree,
+    )
+
+
+def unstack_replicas(tree, rank: int = 0):
+    """Take one replica out of a stacked tree (post-averaging they agree)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda leaf: leaf[rank], tree)
+
+
+class PeriodicModelAverager:
+    """torch `PeriodicModelAverager` (`model_averaging/averagers.py`):
+    `average_parameters` every `period` steps after `warmup_steps`."""
+
+    def __init__(self, group=None, period: int = 4, warmup_steps: int = 0):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from .. import distributed as dist
+
+        self.period = period
+        self.warmup_steps = warmup_steps
+        self.step = 0
+        g = dist._resolve(group)
+        self.group = g
+        axis = g.mesh.axis_names[0]
+
+        from jax import lax
+
+        self._avg = jax.jit(
+            shard_map_fn(
+                lambda p: lax.pmean(p, axis),
+                mesh=g.mesh.jax_mesh,
+                in_specs=P(axis),
+                out_specs=P(axis),
+            )
+        )
+
+    def average_parameters(self, stacked_params):
+        """Counts a step; averages when due. Returns (params, did_average)."""
+        self.step += 1
+        if self.step <= self.warmup_steps or self.step % self.period != 0:
+            return stacked_params, False
+        return self._avg(stacked_params), True
+
+
+def make_localsgd_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    group=None,
+    has_rng: bool = False,
+):
+    """Compile the collective-free per-replica train step.
+
+    `step(stacked_params, stacked_opt_state, x, y[, rng])` — params and
+    opt_state leaves carry a leading replica axis sharded over dp; x/y are
+    batch-sharded as usual. Combine with PeriodicModelAverager for the
+    post-local-SGD schedule. Use `optimizer.init(stacked_params)` mapped
+    per replica via `init_stacked_opt_state`.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    import optax
+
+    from .. import distributed as dist
+
+    g = dist._resolve(group)
+    mesh = g.mesh.jax_mesh
+    axis = g.mesh.axis_names[0]
+
+    def local_step(params, opt_state, x, y, rng):
+        # leading replica axis is 1 per shard inside shard_map; drop it
+        p = jax.tree_util.tree_map(lambda l: l[0], params)
+        o = jax.tree_util.tree_map(lambda l: l[0], opt_state)
+
+        def objective(pp, xm, ym):
+            if has_rng:
+                dev_rng = jax.random.fold_in(rng, lax.axis_index(axis))
+                logits = apply_fn(pp, xm, dev_rng)
+            else:
+                logits = apply_fn(pp, xm)
+            return loss_fn(logits, ym)
+
+        loss, grads = jax.value_and_grad(objective)(p, x, y)
+        updates, o2 = optimizer.update(grads, o, p)
+        p2 = optax.apply_updates(p, updates)
+        expand = lambda l: l[None]
+        return (
+            jax.tree_util.tree_map(expand, p2),
+            jax.tree_util.tree_map(expand, o2),
+            loss[None],  # per-replica loss, stacked
+        )
+
+    mapped = shard_map_fn(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P(axis)),
+    )
+    jitted = jax.jit(mapped, donate_argnums=(0, 1))
+
+    if has_rng:
+
+        def step(params, opt_state, x, y, rng):
+            return jitted(params, opt_state, x, y, rng)
+
+    else:
+
+        def step(params, opt_state, x, y):
+            return jitted(params, opt_state, x, y, jax.random.PRNGKey(0))
+
+    step.mesh = mesh
+    step.axis = axis
+    return step
+
+
+def init_stacked_opt_state(optimizer, stacked_params):
+    """Per-replica optimizer state for stacked params (vmap over axis 0)."""
+    import jax
+
+    return jax.vmap(optimizer.init)(stacked_params)

@@ -53,12 +53,31 @@ class ProcessGroupStatus:
 
 
 class DDPLogger:
-    """Runtime stats for a DDP instance — torch Logger/DDPLoggingData."""
+    """Runtime stats for a DDP instance — torch Logger/DDPLoggingData.
+
+    Component times (torch `reducer.hpp:468-472` timers / `logger.hpp:85-90`
+    calculate_avg_time): under XLA the step is ONE fused program, so
+    per-step fwd/bwd/comm cannot be clocked from Python mid-step. The
+    honest compiled-mode decomposition (DDP.profile_breakdown) times
+    separately-compiled prefixes — forward; forward+backward; full step
+    without reduction; full step — and differences them. Per-step wall
+    times are recorded by the train step itself when `enable_step_timing`
+    is on (synchronous: each timed step blocks, trading pipelining for
+    true wall times, exactly what a profiler run wants).
+    """
 
     def __init__(self, ddp) -> None:
         self._ddp = ddp
         self.step_times: list = []
         self._step_start: Optional[float] = None
+        self.timing_enabled: bool = False
+        self.avg_forward_compute_time_s: float = 0.0
+        self.avg_backward_compute_time_s: float = 0.0
+        self.avg_backward_comm_time_s: float = 0.0
+        self.avg_optimizer_time_s: float = 0.0
+
+    def enable_step_timing(self, enabled: bool = True) -> None:
+        self.timing_enabled = enabled
 
     def step_begin(self) -> None:
         self._step_start = time.perf_counter()
@@ -67,6 +86,16 @@ class DDPLogger:
         if self._step_start is not None:
             self.step_times.append(time.perf_counter() - self._step_start)
             self._step_start = None
+
+    def profiler_trace(self, logdir: str):
+        """Opt-in `jax.profiler.trace` context: run timed steps inside it
+        and the XLA ops (collectives included, tagged with their
+        profiling titles) land in a TensorBoard-readable TPU trace —
+        the analog of torch's `record_function` wrapping DDP.forward
+        (`nn/parallel/distributed.py:1885`)."""
+        import jax
+
+        return jax.profiler.trace(logdir)
 
     def get_ddp_logging_data(self) -> Dict[str, Any]:
         g = self._ddp.process_group
@@ -85,6 +114,10 @@ class DDPLogger:
             "avg_step_time_s": (sum(times) / len(times)) if times else 0.0,
             "num_steps": len(self.step_times),
             "find_unused_parameters": self._ddp.find_unused_parameters,
+            "avg_forward_compute_time_s": self.avg_forward_compute_time_s,
+            "avg_backward_compute_time_s": self.avg_backward_compute_time_s,
+            "avg_backward_comm_time_s": self.avg_backward_comm_time_s,
+            "avg_optimizer_time_s": self.avg_optimizer_time_s,
         }
 
 

@@ -1,0 +1,271 @@
+"""PowerSGD + post-local-SGD tests (torch ddp_comm_hooks parity,
+SURVEY.md §2.1 P6; round-1 VERDICT missing #4 / next-round item 6)."""
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu.parallel import (
+    PeriodicModelAverager,
+    PowerSGDHook,
+    init_stacked_opt_state,
+    make_localsgd_train_step,
+    stack_replicas,
+    unstack_replicas,
+)
+
+
+def _loss_fn():
+    import optax
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    return loss_fn
+
+
+class TestPowerSGD:
+    def test_full_rank_matches_plain_allreduce(self, world):
+        """r >= min(n, m): P spans the full column space, so P P^T M == M —
+        the compressed reduction must reproduce pmean(grads) exactly."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        opt = optax.sgd(0.05)
+        loss_fn = _loss_fn()
+
+        gen = np.random.default_rng(0)
+        W = world.size()
+        # batch >= widest fan-in so every grad matrix is full rank; with
+        # deficient rank, Gram-Schmidt on the null columns amplifies fp32
+        # noise and the reconstruction is only ~1e-2 close (expected).
+        B = 8 * W
+        x = gen.standard_normal((B, 28, 28, 1)).astype(np.float32)
+        y = gen.integers(0, 10, B).astype(np.int32)
+
+        ddp_a = tdx.DistributedDataParallel(model, params)
+        step_a = ddp_a.make_train_step(opt, loss_fn)
+        pa, _, la = step_a(ddp_a.params, opt.init(ddp_a.params), x, y)
+
+        hook = PowerSGDHook(rank=10_000, min_compression_rate=0.0)
+        ddp_b = tdx.DistributedDataParallel(model, params)
+        ddp_b.register_comm_hook(None, hook)
+        step_b = ddp_b.make_train_step(opt, loss_fn)
+        hs = step_b.init_hook_state(ddp_b.params)
+        pb, _, hs, lb = step_b(ddp_b.params, opt.init(ddp_b.params), hs, x, y)
+
+        assert abs(float(la) - float(lb)) < 1e-5
+        for a, b in zip(
+            jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pb)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
+            )
+
+    def test_low_rank_converges_close_to_allreduce(self, world):
+        """VERDICT item 6 acceptance: <=1% final-accuracy delta vs plain
+        allreduce at >=4x gradient compression on the ConvNet."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        opt_f = lambda: optax.sgd(0.05, momentum=0.9)
+        loss_fn = _loss_fn()
+        ds = SyntheticMNIST(512)
+        steps = 25
+
+        def accuracy(p, mod):
+            x, y = ds[np.arange(256)]
+            logits = mod.module.apply(p, x)
+            return float(np.mean(np.argmax(np.asarray(logits), -1) == y))
+
+        # plain allreduce
+        ddp_a = tdx.DistributedDataParallel(model, params)
+        opt = opt_f()
+        step_a = ddp_a.make_train_step(opt, loss_fn)
+        pa, oa = ddp_a.params, opt.init(ddp_a.params)
+        for i in range(steps):
+            idx = np.arange(i * 64, (i + 1) * 64) % len(ds)
+            x, y = ds[idx]
+            pa, oa, _ = step_a(pa, oa, x, y)
+        acc_a = accuracy(pa, ddp_a)
+
+        # PowerSGD rank 2
+        hook = PowerSGDHook(rank=2)
+        ratio = hook.compression_ratio(params)
+        assert ratio >= 4.0, f"compression only {ratio:.1f}x"
+        ddp_b = tdx.DistributedDataParallel(model, params)
+        ddp_b.register_comm_hook(None, hook)
+        opt = opt_f()
+        step_b = ddp_b.make_train_step(opt, loss_fn)
+        pb, ob = ddp_b.params, opt.init(ddp_b.params)
+        hs = step_b.init_hook_state(pb)
+        for i in range(steps):
+            idx = np.arange(i * 64, (i + 1) * 64) % len(ds)
+            x, y = ds[idx]
+            pb, ob, hs, _ = step_b(pb, ob, hs, x, y)
+        acc_b = accuracy(pb, ddp_b)
+
+        assert acc_b >= acc_a - 0.01, (acc_a, acc_b, f"{ratio:.1f}x")
+
+    def test_error_feedback_accumulates(self, world):
+        """With error feedback, the compression residual must be carried in
+        state (non-zero after a step on a full-rank-ish gradient)."""
+        import jax
+        import jax.numpy as jnp
+
+        hook = PowerSGDHook(rank=1, min_compression_rate=0.0)
+        params = {"w": jnp.zeros((8, 8), jnp.float32)}
+        state = hook.init(params)
+        # random full-rank "gradient" cannot be captured by rank 1
+        gen = np.random.default_rng(0)
+        g = {"w": jnp.asarray(gen.standard_normal((8, 8)), jnp.float32)}
+
+        import pytorch_distributed_example_tpu.distributed as dist
+
+        axis = "_ranks"
+        from jax.sharding import PartitionSpec as P
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+
+        mesh = world.mesh.jax_mesh
+
+        def f(state, grads):
+            out, st = hook.apply(state, grads, axis)
+            return out, st
+
+        mapped = jax.jit(
+            shard_map_fn(
+                f, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P())
+            )
+        )
+        out, st = mapped(state, g)
+        err = np.asarray(st["error"][0])
+        assert np.abs(err).max() > 1e-3  # residual carried
+        # approx + error reconstructs the (mean) gradient
+        np.testing.assert_allclose(
+            np.asarray(out["w"]) + err, np.asarray(g["w"]), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestPostLocalSGD:
+    def test_local_steps_diverge_and_average_reconciles(self, world):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        W = world.size()
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        opt = optax.sgd(0.05)
+
+        stacked = stack_replicas(params, W)
+        opt_state = init_stacked_opt_state(opt, stacked)
+        step = make_localsgd_train_step(
+            lambda p, x: model.apply(p, x), _loss_fn(), opt, world
+        )
+        averager = PeriodicModelAverager(world, period=2)
+
+        gen = np.random.default_rng(0)
+        x = gen.standard_normal((2 * W, 28, 28, 1)).astype(np.float32)
+        y = gen.integers(0, 10, 2 * W).astype(np.int32)
+
+        # one local step: replicas see different shards -> drift
+        stacked, opt_state, losses = step(stacked, opt_state, x, y)
+        leaf = np.asarray(jax.tree_util.tree_leaves(stacked)[0])
+        drift = np.abs(leaf - leaf[0:1]).max()
+        assert drift > 0, "replicas should drift between averages"
+
+        # step 1: no average (period 2); step 2: average
+        _, did = averager.average_parameters(stacked)
+        assert not did
+        stacked, opt_state, losses = step(stacked, opt_state, x, y)
+        stacked, did = averager.average_parameters(stacked)
+        assert did
+        leaf = np.asarray(jax.tree_util.tree_leaves(stacked)[0])
+        np.testing.assert_allclose(leaf, np.broadcast_to(leaf[0:1], leaf.shape), rtol=1e-5, atol=1e-6)
+
+    def test_localsgd_with_period1_tracks_ddp(self, world):
+        """period=1 local SGD == DDP per-step averaging for SGD (linear
+        optimizer): averaging params after local sgd step == stepping with
+        averaged grads."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        W = world.size()
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        opt = optax.sgd(0.05)
+        loss_fn = _loss_fn()
+
+        gen = np.random.default_rng(0)
+        x = gen.standard_normal((2 * W, 28, 28, 1)).astype(np.float32)
+        y = gen.integers(0, 10, 2 * W).astype(np.int32)
+
+        ddp = tdx.DistributedDataParallel(model, params)
+        step_d = ddp.make_train_step(opt, loss_fn)
+        pd, _, _ = step_d(ddp.params, opt.init(ddp.params), x, y)
+
+        stacked = stack_replicas(params, W)
+        step_l = make_localsgd_train_step(
+            lambda p, x: model.apply(p, x), loss_fn, opt, world
+        )
+        averager = PeriodicModelAverager(world, period=1)
+        stacked, _, _ = step_l(stacked, init_stacked_opt_state(opt, stacked), x, y)
+        stacked, did = averager.average_parameters(stacked)
+        assert did
+        pl = unstack_replicas(stacked)
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(pd), jax.tree_util.tree_leaves(pl)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
+    def test_error_feedback_is_per_rank(self, world):
+        """Regression: hook state is SHARDED over dp — each rank's
+        error-feedback residual must evolve from its own data shard, not
+        be collapsed to one rank's copy (review finding: replicated
+        out_spec silently discarded all but one residual)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        hook = PowerSGDHook(rank=1, min_compression_rate=0.0)
+        ddp = tdx.DistributedDataParallel(model, params)
+        ddp.register_comm_hook(None, hook)
+        opt = optax.sgd(0.05)
+        step = ddp.make_train_step(opt, _loss_fn())
+        W = world.size()
+        gen = np.random.default_rng(0)
+        # per-rank DIFFERENT data shards -> different residuals
+        x = gen.standard_normal((2 * W, 28, 28, 1)).astype(np.float32)
+        y = gen.integers(0, 10, 2 * W).astype(np.int32)
+        hs = step.init_hook_state(ddp.params)
+        _, _, hs, _ = step(ddp.params, opt.init(ddp.params), hs, x, y)
+        # find a compressed leaf's error buffer: (W, n, m)
+        errs = [e for e in hs["error"] if e.ndim == 3 and e.shape[1] > 0]
+        assert errs, "no compressed leaves in state"
+        e = np.asarray(errs[-1])
+        assert e.shape[0] == W
+        diffs = np.abs(e - e[0:1]).max()
+        assert diffs > 1e-6, "per-rank residuals were collapsed"

@@ -203,6 +203,20 @@ COLLECTIVES_WORKER = textwrap.dedent(
     tdx.all_reduce(t2)
     tdx.monitored_barrier()
 
+    # 7. p2p send/recv: blocking receive of the peer's tensor (torch
+    # contract; round-1 had no multiproc p2p at all)
+    if rank == 0:
+        tdx.send(np.array([3.25, 4.5], np.float32), dst=1, tag=7)
+        buf = np.zeros((2,), np.float32)
+        got_src = tdx.recv(buf, src=1, tag=8)
+        assert got_src == 1 and buf.tolist() == [9.0, 10.0], buf
+    else:
+        buf = np.zeros((2,), np.float32)
+        w = tdx.irecv(buf, src=0, tag=7)  # deferred receive
+        w.wait()
+        assert buf.tolist() == [3.25, 4.5], buf
+        tdx.isend(np.array([9.0, 10.0], np.float32), dst=0, tag=8).wait()
+
     # --- DDP: divergent init must become identical after wrap -------------
     import hashlib
     import jax.numpy as jnp

@@ -187,3 +187,67 @@ class TestDDPLogger:
         assert data["bucket_cap_bytes"] == 25 * 1024 * 1024
         assert data["num_steps"] == 1
         assert data["avg_step_time_s"] > 0
+
+
+class TestProfilingTier:
+    """Round-2 §5.1 parity: component times in DDPLoggingData + opt-in
+    jax.profiler trace (torch reducer.hpp:468-472, logger.hpp:85-90)."""
+
+    def _setup(self, world):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+
+        model = ConvNet()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        ddp = tdx.DistributedDataParallel(model, params)
+        opt = optax.sgd(0.05)
+
+        def loss_fn(logits, y):
+            import optax as _o
+
+            return _o.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        W = world.size()
+        x = np.random.default_rng(0).standard_normal((2 * W, 28, 28, 1)).astype(np.float32)
+        y = np.random.default_rng(1).integers(0, 10, 2 * W).astype(np.int32)
+        return ddp, opt, loss_fn, x, y
+
+    def test_step_timing_recorded_by_train_step(self, world):
+        import optax
+
+        ddp, opt, loss_fn, x, y = self._setup(world)
+        step = ddp.make_train_step(opt, loss_fn)
+        ddp.logger.enable_step_timing()
+        p, o = ddp.params, opt.init(ddp.params)
+        for _ in range(3):
+            p, o, _ = step(p, o, x, y)
+        data = ddp.get_ddp_logging_data()
+        assert data["num_steps"] == 3
+        assert data["avg_step_time_s"] > 0
+
+    def test_profile_breakdown_fills_component_times(self, world):
+        ddp, opt, loss_fn, x, y = self._setup(world)
+        out = ddp.profile_breakdown(opt, loss_fn, x, y, iters=3)
+        data = ddp.get_ddp_logging_data()
+        assert data["avg_forward_compute_time_s"] > 0
+        assert data["avg_backward_compute_time_s"] > 0
+        assert out["full_step_s"] > 0
+        # components are a decomposition: each <= the full step
+        assert out["forward_s"] <= out["full_step_s"] * 1.5
+
+    def test_profiler_trace_context_writes_trace(self, world, tmp_path):
+        ddp, opt, loss_fn, x, y = self._setup(world)
+        step = ddp.make_train_step(opt, loss_fn)
+        logdir = str(tmp_path / "trace")
+        with ddp.logger.profiler_trace(logdir):
+            p, o = ddp.params, opt.init(ddp.params)
+            p, o, _ = step(p, o, x, y)
+        import os as _os
+
+        found = []
+        for root, _, files in _os.walk(logdir):
+            found.extend(files)
+        assert found, "profiler trace produced no files"
