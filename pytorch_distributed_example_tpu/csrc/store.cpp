@@ -313,7 +313,16 @@ void tdx_store_server_stop(void* h) {
 // -- client ---------------------------------------------------------------
 void* tdx_store_client_connect(const char* host, int port, double timeout_s) {
   auto* c = new Client();
-  double remaining = timeout_s;
+  // Budget is wall-clock against a monotonic deadline. (An earlier version
+  // debited a flat 1.0s per EINPROGRESS poll; on loopback a refused
+  // connect completes the poll in microseconds, so a 120s budget burned
+  // in ~6s of wall time and slow-starting peers were never reached.)
+  auto now = []() {
+    struct timespec t;
+    clock_gettime(CLOCK_MONOTONIC, &t);
+    return static_cast<double>(t.tv_sec) + t.tv_nsec * 1e-9;
+  };
+  const double deadline = now() + timeout_s;
   const double step = 0.05;
   while (true) {
     c->fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -328,6 +337,8 @@ void* tdx_store_client_connect(const char* host, int port, double timeout_s) {
     int rc = connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
     bool ok = (rc == 0);
     if (!ok && errno == EINPROGRESS) {
+      double remaining = deadline - now();
+      if (remaining < 0) remaining = 0;
       pollfd pfd{c->fd, POLLOUT, 0};
       int pr = poll(&pfd, 1, static_cast<int>(std::min(remaining, 1.0) * 1000));
       if (pr > 0) {
@@ -336,7 +347,6 @@ void* tdx_store_client_connect(const char* host, int port, double timeout_s) {
         getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
         ok = (err == 0);
       }
-      remaining -= std::min(remaining, 1.0);
     }
     if (ok) {
       fcntl(c->fd, F_SETFL, flags);  // back to blocking + timeouts below
@@ -350,8 +360,7 @@ void* tdx_store_client_connect(const char* host, int port, double timeout_s) {
       return c;
     }
     close(c->fd);
-    remaining -= step;
-    if (remaining <= 0) {
+    if (now() + step >= deadline) {
       delete c;
       return nullptr;
     }

@@ -935,10 +935,28 @@ def shrink_group(
 
 
 def gather_object(obj: Any, object_gather_list: Optional[List[Any]] = None, dst: int = 0, group=None):
-    """torch `gather_object`: driver mode gathers every rank's object (the
-    per-rank objects come from `obj` when it is a per-rank list)."""
+    """torch `gather_object` with dst semantics: only dst's
+    `object_gather_list` is filled; other ranks get None back (torch
+    `distributed_c10d.py` gather_object contract). Driver mode gathers
+    every rank's object (the per-rank objects come from `obj` when it is
+    a per-rank list) — the driver acts for dst. Multiproc note: routed
+    over all_gather (each rank briefly holds all objects); object
+    payloads are control-plane sized, so the extra bytes are accepted
+    for one code path in both modes."""
     g = _resolve(group)
     W = g.size()
+    g._check_member(dst)
+    if _world.mode == "multiproc":
+        if g.rank() == dst and object_gather_list is None:
+            raise ValueError(
+                "gather_object: dst rank must pass object_gather_list"
+            )
+        gathered = all_gather_object(obj, g)
+        if g.rank() != dst:
+            return None
+        del object_gather_list[:]
+        object_gather_list.extend(gathered)
+        return gathered
     if not (isinstance(obj, list) and len(obj) == W):
         raise ValueError(
             f"driver mode: gather_object takes the per-rank object list "
@@ -1233,10 +1251,23 @@ def _array_to_obj(arr: np.ndarray, length: int):
 def all_gather_object(objects: Sequence[Any], group=None) -> List[Any]:
     """torch `all_gather_object` (`:3439`). Driver mode: `objects[r]` is
     rank r's object; returns the gathered list (what every rank would see).
-    Exercises the real tensor path: pickle → uint8 DistTensor → length
-    all_reduce(MAX) → padded all_gather → unpickle."""
+    Multiproc mode (torch-true signature): `objects` is THIS process's
+    single object. Both exercise the real tensor path: pickle → uint8
+    DistTensor → length all_gather → padded all_gather → unpickle."""
     g = _resolve(group)
     W = g.size()
+    if _world.mode == "multiproc":
+        buf = _obj_to_array(objects)
+        lt = DistTensor.from_process_local(np.array([len(buf)], np.int64), g)
+        lens_dt = all_gather(lt, g)  # per-rank value (W, 1)
+        lens = lens_dt.local_numpy()[0][:, 0].astype(int)
+        max_len = max(int(l) for l in lens) or 1
+        padded = np.zeros((max_len,), np.uint8)
+        padded[: len(buf)] = buf
+        dt = DistTensor.from_process_local(padded, g)
+        gathered = all_gather(dt, g)  # per-rank value (W, max_len)
+        flat = gathered.local_numpy()[0]
+        return [_array_to_obj(flat[i], int(lens[i])) for i in range(W)]
     if len(objects) != W:
         raise ValueError(f"need one object per rank ({W}), got {len(objects)}")
     bufs = [_obj_to_array(o) for o in objects]
@@ -1257,9 +1288,32 @@ def all_gather_object(objects: Sequence[Any], group=None) -> List[Any]:
 def broadcast_object_list(object_list: List[Any], src: int = 0, group=None) -> None:
     """torch `broadcast_object_list` (`:3925`). Driver mode: `object_list`
     is the per-rank slot list; after the call every slot holds src's
-    object (routed through a real broadcast collective)."""
+    object (routed through a real broadcast collective). Multiproc mode
+    (torch-true): a list of k objects per process, replaced in place with
+    src's contents."""
     g = _resolve(group)
     W = g.size()
+    if _world.mode == "multiproc":
+        k = len(object_list)
+        lens = np.array([len(_obj_to_array(o)) for o in object_list], np.int64)
+        lt = DistTensor.from_process_local(lens, g)
+        broadcast(lt, src, g)
+        # post-broadcast, src_lens is identical everywhere — it IS the
+        # agreed padded size; no extra MAX collective needed, and non-src
+        # payloads never survive the broadcast so only src fills buffers
+        src_lens = lt.local_numpy()[0].astype(int)
+        max_len = int(max([*src_lens.tolist(), 1]))
+        padded = np.zeros((k, max_len), np.uint8)
+        if g.rank() == src:
+            for i, o in enumerate(object_list):
+                b = _obj_to_array(o)
+                padded[i, : len(b)] = b
+        dt = DistTensor.from_process_local(padded, g)
+        broadcast(dt, src, g)
+        out = dt.local_numpy()[0]
+        for i in range(k):
+            object_list[i] = _array_to_obj(out[i], int(src_lens[i]))
+        return
     if len(object_list) != W:
         raise ValueError(f"need one slot per rank ({W}), got {len(object_list)}")
     bufs = [_obj_to_array(o) for o in object_list]
@@ -1286,9 +1340,24 @@ def scatter_object_list(
 ) -> None:
     """torch `scatter_object_list` (`:4057`). Driver mode:
     `scatter_object_input_list` is src's list of W objects; output list gets
-    one object per rank."""
+    one object per rank. Multiproc mode (torch-true): only src needs the
+    input list; each process's output list receives its one object."""
     g = _resolve(group)
     W = g.size()
+    if _world.mode == "multiproc":
+        me = g.rank()
+        if me == src:
+            if scatter_object_input_list is None or len(scatter_object_input_list) != W:
+                raise ValueError(f"src must provide {W} objects")
+            objs = list(scatter_object_input_list)
+        else:
+            objs = [None] * W
+        # route over broadcast (src's payloads, one slot per rank), then
+        # keep own slot — object payloads are control-plane sized
+        broadcast_object_list(objs, src, g)
+        del scatter_object_output_list[:]
+        scatter_object_output_list.append(objs[me])
+        return
     if scatter_object_input_list is None or len(scatter_object_input_list) != W:
         raise ValueError(f"src must provide {W} objects")
     bufs = [_obj_to_array(o) for o in scatter_object_input_list]

@@ -203,7 +203,25 @@ COLLECTIVES_WORKER = textwrap.dedent(
     tdx.all_reduce(t2)
     tdx.monitored_barrier()
 
-    # 7. p2p send/recv: blocking receive of the peer's tensor (torch
+    # 7. object collectives, torch-true multiproc signatures
+    got = tdx.all_gather_object({"rank": rank, "tag": "x" * (rank + 1)})
+    assert [g["rank"] for g in got] == list(range(world)), got
+    objs = [f"obj{rank}" for _ in range(2)]
+    tdx.broadcast_object_list(objs, src=0)
+    assert objs == ["obj0", "obj0"], objs
+    glist = [] if rank == 0 else None
+    gathered = tdx.gather_object({"r": rank}, glist, dst=0)
+    if rank == 0:
+        assert [g["r"] for g in glist] == list(range(world))
+    else:
+        assert gathered is None
+    out_list = []
+    tdx.scatter_object_list(
+        out_list, [f"chunk{r}" for r in range(world)] if rank == 0 else None, src=0
+    )
+    assert out_list == [f"chunk{rank}"], out_list
+
+    # 8. p2p send/recv: blocking receive of the peer's tensor (torch
     # contract; round-1 had no multiproc p2p at all)
     if rank == 0:
         tdx.send(np.array([3.25, 4.5], np.float32), dst=1, tag=7)

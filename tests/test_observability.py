@@ -251,3 +251,61 @@ class TestProfilingTier:
         for root, _, files in _os.walk(logdir):
             found.extend(files)
         assert found, "profiler trace produced no files"
+
+
+class TestDebugHTTPFrontend:
+    """torch debug/_frontend.py parity (§5.5): live state over HTTP."""
+
+    def test_routes_serve_runtime_state(self, world):
+        import json
+        import urllib.request
+
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import ConvNet
+        from pytorch_distributed_example_tpu.utils.debug_http import DebugServer
+
+        srv = DebugServer()
+        try:
+            def get(path):
+                with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+                    return json.loads(r.read().decode())
+
+            idx = get("/")
+            assert "/status" in idx["routes"]
+
+            w = get("/world")
+            assert w["initialized"] and w["mode"] == "driver"
+            assert "default_pg" in w["groups"]
+
+            # drive one collective so status/flight recorder have content
+            t = tdx.DistTensor.from_rank_fn(
+                lambda r: np.array([float(r)], np.float32)
+            )
+            tdx.all_reduce(t)
+            t.block_until_ready()
+
+            st = get("/status")
+            assert st["default_pg"]["last_enqueued_op"] == "all_reduce"
+
+            fr = get("/flight_recorder")
+            assert any(e.get("op") == "all_reduce" for e in fr["entries"])
+
+            model = ConvNet()
+            params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+            ddp = tdx.DistributedDataParallel(model, params)
+            srv.register_ddp_logger("convnet", ddp.logger)
+            dl = get("/ddp_logging")
+            assert dl["convnet"]["world_size"] == world.size()
+
+            # unknown route -> 404
+            import urllib.error
+
+            try:
+                get("/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.shutdown()
