@@ -1,0 +1,128 @@
+"""Sharded (distributed) checkpoint — torch DCP parity over orbax.
+
+Parity surface: `torch/distributed/checkpoint/` (DCP `save`/`load`:
+each rank writes its own shards, load reshards to the running topology).
+The reference example never touches it (SURVEY.md §5.4), but the stack
+ships it, and an FSDP/GSPMD-sharded model cannot round-trip through the
+rank-0 npz path (`checkpoint.py`) without materializing the full tree on
+one host.
+
+TPU-native resolution: orbax-checkpoint IS the native sharded-checkpoint
+engine on this stack (per-shard OCDBT/zarr files + a global index,
+async-capable, multi-host aware), so this module is a thin c10d-shaped
+facade over it rather than a reimplementation:
+
+  * `dcp_save(state, path)` — every process writes the shards it owns.
+  * `dcp_load(template, path)` — restores INTO the template's shardings
+    (resharding on load: the saved mesh and the running mesh may differ,
+    matching DCP's re-topology guarantee).
+
+The torch-shaped `state_dict`/`load_state_dict` naming is kept so users
+migrating from `torch.distributed.checkpoint` find the seam.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["dcp_save", "dcp_load", "DCPCheckpointer"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def _to_restore_args(template):
+    """Map a template tree to orbax restore args: any leaf carrying a
+    `.sharding` (jax.Array or ShapeDtypeStruct) restores INTO it."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    def one(leaf):
+        if hasattr(leaf, "sharding"):
+            return ocp.ArrayRestoreArgs(
+                sharding=leaf.sharding,
+                global_shape=tuple(leaf.shape),
+                dtype=leaf.dtype,
+            )
+        return ocp.RestoreArgs()
+
+    return jax.tree_util.tree_map(one, template)
+
+
+def dcp_save(state: Any, path: str, *, force: bool = True) -> str:
+    """Write a (possibly sharded) pytree; each process persists only its
+    addressable shards. Returns the checkpoint directory."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=force)
+    return path
+
+
+def dcp_load(template: Any, path: str) -> Any:
+    """Restore into `template`'s structure AND shardings.
+
+    `template` supplies the target tree: jax.Arrays (their
+    NamedSharding is the restore sharding — resharding happens here if it
+    differs from save time), or `jax.ShapeDtypeStruct`s with `.sharding`
+    for a memory-light template.
+    """
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    return ckptr.restore(path, item=template, restore_args=_to_restore_args(template))
+
+
+class DCPCheckpointer:
+    """Step-numbered checkpoint manager — the `CheckpointManager` shape
+    (keep-last-k, latest-step query) torch users reach for around DCP."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any) -> bool:
+        import orbax.checkpoint as ocp
+
+        ok = self._mgr.save(step, args=ocp.args.PyTreeSave(state))
+        self._mgr.wait_until_finished()
+        return ok
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if template is None:
+            return self._mgr.restore(step)
+        return self._mgr.restore(
+            step,
+            args=ocp.args.PyTreeRestore(
+                item=template, restore_args=_to_restore_args(template)
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+
+# torch.distributed.checkpoint-shaped aliases
+save = dcp_save
+load = dcp_load

@@ -1295,7 +1295,12 @@ def broadcast_object_list(object_list: List[Any], src: int = 0, group=None) -> N
     W = g.size()
     if _world.mode == "multiproc":
         k = len(object_list)
-        lens = np.array([len(_obj_to_array(o)) for o in object_list], np.int64)
+        # torch ignores non-src contents pre-call; don't even pickle them
+        # (placeholders may be unpicklable or large)
+        if g.rank() == src:
+            lens = np.array([len(_obj_to_array(o)) for o in object_list], np.int64)
+        else:
+            lens = np.zeros((k,), np.int64)
         lt = DistTensor.from_process_local(lens, g)
         broadcast(lt, src, g)
         # post-broadcast, src_lens is identical everywhere — it IS the

@@ -30,6 +30,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
 
 
+class _UnknownRoute(Exception):
+    """Raised only by route dispatch — a KeyError from inside a handler
+    must surface as a 500 with the real exception, not a fake 404."""
+
+
 class DebugServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._loggers: Dict[str, object] = {}
@@ -42,7 +47,7 @@ class DebugServer:
             def do_GET(self):
                 try:
                     payload = outer._route(self.path)
-                except KeyError:
+                except _UnknownRoute:
                     self.send_response(404)
                     self.end_headers()
                     self.wfile.write(b'{"error": "unknown route"}')
@@ -89,7 +94,7 @@ class DebugServer:
                 name: lg.get_ddp_logging_data()
                 for name, lg in self._loggers.items()
             }
-        raise KeyError(path)
+        raise _UnknownRoute(path)
 
     def _world(self):
         from .. import distributed as dist

@@ -1,0 +1,24 @@
+"""Shared helpers for the multi-process test harnesses
+(tests/test_multiprocess.py, tests/test_multiprocess_continuous.py)."""
+
+import os
+import socket
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_env() -> dict:
+    """Child-process env: repo importable; no inherited pytest XLA_FLAGS
+    device-count override (each process brings exactly one CPU device)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = ""
+    return env

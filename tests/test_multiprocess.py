@@ -18,15 +18,7 @@ import textwrap
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from tests._mp_util import REPO, free_port as _free_port, worker_env
 
 
 WORKER = textwrap.dedent(
@@ -107,11 +99,7 @@ def test_multiprocess_bringup_and_psum(tmp_path, world):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # children must not inherit pytest's XLA_FLAGS device-count override:
-    # each process brings exactly one CPU device to the global mesh
-    env["XLA_FLAGS"] = ""
+    env = worker_env()
 
     procs = [
         subprocess.Popen(
@@ -330,9 +318,7 @@ def _run_workers(tmp_path, script_body, world, timeout=240):
     jport, sport = _free_port(), _free_port()
     script = tmp_path / "worker.py"
     script.write_text(script_body)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = ""
+    env = worker_env()
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(r), str(world), str(jport), str(sport)],

@@ -20,16 +20,9 @@ import textwrap
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._mp_util import REPO, free_port as _free_port, worker_env
+
 WORLD = 2
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 LOOP_WORKER = textwrap.dedent(
@@ -64,6 +57,7 @@ LOOP_WORKER = textwrap.dedent(
         src = pg.store.get(f"task/{n}")
         if src == b"__STOP__":
             break
+        ns.pop("result", None)  # never report a stale value from a prior body
         try:
             exec(src.decode(), ns)
             res = (True, ns.get("result"))
@@ -87,9 +81,7 @@ class Gang:
         script = os.path.join(tmpdir, "loop_worker.py")
         with open(script, "w") as f:
             f.write(LOOP_WORKER)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["XLA_FLAGS"] = ""
+        env = worker_env()
         self.procs = [
             subprocess.Popen(
                 [sys.executable, script, str(r), str(WORLD), str(jport), str(sport)],
