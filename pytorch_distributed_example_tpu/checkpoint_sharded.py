@@ -56,8 +56,6 @@ def _to_restore_args(template):
 def dcp_save(state: Any, path: str, *, force: bool = True) -> str:
     """Write a (possibly sharded) pytree; each process persists only its
     addressable shards. Returns the checkpoint directory."""
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     ckptr.save(path, state, force=force)

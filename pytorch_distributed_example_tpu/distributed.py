@@ -204,6 +204,7 @@ class _WorldState:
     process_rank: int = 0
     store: Optional[Store] = None
     generation: int = 0  # init_process_group incarnation (store-key scope)
+    scope: str = "0"  # full store-key scope: incarnation + agent restart gen
 
 
 _world = _WorldState()
@@ -271,6 +272,23 @@ def init_process_group(
     backend = (backend or "xla").lower()
     tsec = _timeout_seconds(timeout)
 
+    # Launcher contract: tpurun exports TDX_JAX_COORDINATOR (store host,
+    # port+1). If the jax multi-controller runtime is not up yet, bring it
+    # up here so `tpurun script.py` works with a bare init_process_group —
+    # the jax analog of torchrun's workers joining the c10d rendezvous.
+    coord = os.environ.get("TDX_JAX_COORDINATOR")
+    if (
+        coord
+        and os.environ.get("WORLD_SIZE")
+        and int(os.environ["WORLD_SIZE"]) > 1
+        and not jax.distributed.is_initialized()
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["WORLD_SIZE"]),
+            process_id=int(os.environ.get("RANK", rank if rank >= 0 else 0)),
+        )
+
     try:
         multiproc = jax.process_count() > 1
     except Exception as e:
@@ -325,7 +343,12 @@ def init_process_group(
     global _init_generation
     _init_generation += 1
     _world.generation = _init_generation
-    prefixed = PrefixStore(f"default_pg_gen{_init_generation}", store)
+    # Under an elastic agent with a PERSISTENT store (multi-node restarts
+    # keep node 0's daemon alive), fresh worker processes all restart at
+    # incarnation 1 — the agent's restart count disambiguates them.
+    rc = os.environ.get("TDX_RESTART_COUNT")
+    _world.scope = f"{_init_generation}" + (f"_r{rc}" if rc else "")
+    prefixed = PrefixStore(f"default_pg_gen{_world.scope}", store)
 
     if device_mesh is not None:
         mesh = device_mesh
@@ -434,11 +457,11 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
             if _world.mode == "multiproc" and _world.default_pg is not None:
                 try:
                     w = _world.default_pg.size()
-                    gen = _world.generation
-                    st.set(f"tdx_destroy/gen{gen}/{_world.process_rank}", b"1")
+                    scope = _world.scope
+                    st.set(f"tdx_destroy/gen{scope}/{_world.process_rank}", b"1")
                     if getattr(st, "is_master", False):
                         st.wait(
-                            [f"tdx_destroy/gen{gen}/{r}" for r in range(w)],
+                            [f"tdx_destroy/gen{scope}/{r}" for r in range(w)],
                             min(30.0, _world.default_pg.timeout),
                         )
                 except Exception:
@@ -1100,10 +1123,11 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Work]:
     return works
 
 
-def _p2p_key(gen: int, src: int, dst: int, tag: int, seq: int) -> str:
-    # gen disambiguates init/destroy incarnations: subgroup PrefixStore
-    # names ("group_N") reset with _world, so without it an unconsumed
-    # send from a dead incarnation would be delivered to the next one.
+def _p2p_key(gen, src: int, dst: int, tag: int, seq: int) -> str:
+    # gen disambiguates init/destroy incarnations (and agent restart
+    # generations): subgroup PrefixStore names ("group_N") reset with
+    # _world, so without it an unconsumed send from a dead incarnation
+    # would be delivered to the next one.
     return f"p2p/g{gen}/{src}->{dst}/t{tag}/{seq}"
 
 
@@ -1130,7 +1154,7 @@ def _store_send(tensor, dst: int, g: ProcessGroup, tag: int) -> None:
     seq = ctr.get((dst, tag), 0)
     ctr[(dst, tag)] = seq + 1
     val = np.asarray(tensor.local_numpy()[0] if isinstance(tensor, DistTensor) else tensor)
-    g.store.set(_p2p_key(_world.generation, me, dst, tag, seq), pickle.dumps(val))
+    g.store.set(_p2p_key(_world.scope, me, dst, tag, seq), pickle.dumps(val))
 
 
 def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
@@ -1138,7 +1162,7 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
     ctr = _p2p_counters(g, "recv")
     seq = ctr.get((src, tag), 0)
     ctr[(src, tag)] = seq + 1
-    key = _p2p_key(_world.generation, src, me, tag, seq)
+    key = _p2p_key(_world.scope, src, me, tag, seq)
     g.store.wait([key], timeout)
     val = pickle.loads(g.store.get(key))
     try:

@@ -44,9 +44,16 @@ class WorkerSpec:
     max_restarts: int = 3  # torchelastic default (api.py:96)
     monitor_interval_s: float = 0.1
     master_addr: str = "127.0.0.1"
-    master_port: int = 0  # 0 = pick free port
+    master_port: int = 0  # 0 = pick free port (single-node only)
     raw_cmd: bool = False  # entrypoint is a full argv, not a python script
+    module: bool = False  # entrypoint is a module name (python -m ...)
+    nnodes: int = 1  # torchrun --nnodes
+    node_rank: int = 0  # torchrun --node-rank; node 0 hosts the store
     env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def world_size(self) -> int:
+        return self.nnodes * self.nproc_per_node
 
 
 @dataclass
@@ -68,48 +75,114 @@ class LocalElasticAgent:
         self.spec = spec
         self.log_dir = log_dir
         self._store: Optional[TCPStore] = None
+        self._ctrl: Optional[TCPStore] = None
         self._workers: List[_Worker] = []
         self.restart_count = 0
 
     # -- store hosting -----------------------------------------------------
-    def _ensure_store(self) -> TCPStore:
+    def _ensure_store(self) -> Optional[TCPStore]:
+        """Node 0's agent hosts the rendezvous store; other nodes only
+        point their workers at it (torchrun: the c10d rdzv backend lives
+        on the --rdzv-endpoint host)."""
+        if self.spec.nnodes > 1 and self.spec.master_port == 0:
+            raise ValueError(
+                "multi-node launch needs an explicit master/rdzv port "
+                "(port 0 cannot be discovered by other nodes)"
+            )
+        if self.spec.node_rank != 0:
+            return None
         if self._store is None:
             self._store = TCPStore(
                 self.spec.master_addr,
                 self.spec.master_port,
-                world_size=self.spec.nproc_per_node,
+                world_size=self.spec.world_size,
                 is_master=True,
                 timeout=300.0,
             )
         return self._store
 
+    def _control(self) -> Optional[TCPStore]:
+        """Agent-to-agent control plane (restart propagation) — a client
+        handle into the shared store. Multi-node only."""
+        if self.spec.nnodes <= 1:
+            return None
+        if self._ctrl is None:
+            if self.spec.node_rank == 0:
+                self._ctrl = self._ensure_store()  # daemon handle doubles as client
+            else:
+                self._ctrl = TCPStore(
+                    self.spec.master_addr,
+                    self.spec.master_port,
+                    world_size=self.spec.world_size,
+                    is_master=False,
+                    timeout=300.0,
+                )
+        return self._ctrl
+
+    @staticmethod
+    def _peek(store: TCPStore, key: str) -> Optional[bytes]:
+        try:
+            if store.check([key]):
+                return store.get(key)
+        except Exception:
+            pass
+        return None
+
     # -- spawn -------------------------------------------------------------
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
     def _start_workers(self) -> None:
         store = self._ensure_store()
+        port = store.port if store is not None else self.spec.master_port
+        # jax coordinator port: single-node picks a fresh free port per
+        # generation (store_port+1 may be held by an unrelated process);
+        # multi-node keeps the store_port+1 convention because every node
+        # must DERIVE it from the shared endpoint — documented in the CLI
+        # (the +1 port must be reachable on the rdzv host).
+        if self.spec.nnodes == 1:
+            jax_port = self._free_port()
+        else:
+            jax_port = port + 1
         self._workers = []
         for r in range(self.spec.nproc_per_node):
+            global_rank = self.spec.node_rank * self.spec.nproc_per_node + r
             env = {
                 **os.environ,
                 **self.spec.env,
-                "RANK": str(r),
+                "RANK": str(global_rank),
                 "LOCAL_RANK": str(r),
-                "WORLD_SIZE": str(self.spec.nproc_per_node),
+                "GROUP_RANK": str(self.spec.node_rank),
+                "LOCAL_WORLD_SIZE": str(self.spec.nproc_per_node),
+                "WORLD_SIZE": str(self.spec.world_size),
                 "MASTER_ADDR": self.spec.master_addr,
-                "MASTER_PORT": str(store.port),
+                "MASTER_PORT": str(port),
                 "TDX_RESTART_COUNT": str(self.restart_count),
                 "TORCHELASTIC_RESTART_COUNT": str(self.restart_count),
-                "TDX_AGENT_STORE": f"{self.spec.master_addr}:{store.port}",
+                "TDX_AGENT_STORE": f"{self.spec.master_addr}:{port}",
                 # env:// rendezvous must CONNECT to the agent's store, not
                 # bind MASTER_PORT itself (torchelastic's
                 # TORCHELASTIC_USE_AGENT_STORE contract)
                 "TDX_USE_AGENT_STORE": "1",
                 "TORCHELASTIC_USE_AGENT_STORE": "True",
+                # jax multi-controller bring-up: workers (or
+                # init_process_group itself) initialize jax.distributed
+                # against this coordinator (see jax_port selection above)
+                "TDX_JAX_COORDINATOR": f"{self.spec.master_addr}:{jax_port}",
             }
-            argv = (
-                list(self.spec.entrypoint)
-                if self.spec.raw_cmd
-                else [sys.executable] + list(self.spec.entrypoint)
-            )
+            if self.spec.raw_cmd:
+                argv = list(self.spec.entrypoint)
+            elif self.spec.module:
+                argv = [sys.executable, "-m"] + list(self.spec.entrypoint)
+            else:
+                argv = [sys.executable] + list(self.spec.entrypoint)
             stdout = stderr = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
@@ -139,13 +212,56 @@ class LocalElasticAgent:
 
     # -- monitor (api.py:499) ---------------------------------------------
     def _monitor(self) -> WorkerState:
+        """Poll local workers AND (multi-node) the agent control plane: a
+        peer node's failure must restart THIS node's workers too — they
+        are blocked in collectives that can never complete. torchelastic
+        achieves the same via its dynamic rendezvous round; here the
+        shared store carries a monotonic restart-generation key."""
+        ctrl = self._control()
         while True:
             time.sleep(self.spec.monitor_interval_s)
             codes = {w.local_rank: w.proc.poll() for w in self._workers}
             if any(c is not None and c != 0 for c in codes.values()):
+                if ctrl is not None:
+                    ctrl.set("agent/restart_gen", str(self.restart_count + 1))
                 return WorkerState.FAILED
             if all(c == 0 for c in codes.values()):
                 return WorkerState.SUCCEEDED
+            if ctrl is not None:
+                g = self._peek(ctrl, "agent/restart_gen")
+                if g is not None and int(g) > self.restart_count:
+                    return WorkerState.FAILED  # peer-signaled restart
+                if self._peek(ctrl, "agent/fatal") is not None:
+                    return WorkerState.FAILED
+
+    def _restart_barrier(self) -> bool:
+        """Multi-node: agree on the new generation before respawning, so
+        every node's workers re-rendezvous under the same restart scope.
+        Returns False if the gang must give up (budget exhausted anywhere)."""
+        ctrl = self._control()
+        if ctrl is None:
+            return True
+        if self._peek(ctrl, "agent/fatal") is not None:
+            return False
+        g = self._peek(ctrl, "agent/restart_gen")
+        target = max(int(g) if g is not None else 0, self.restart_count + 1)
+        if target > self.spec.max_restarts:
+            ctrl.set("agent/fatal", b"1")
+            return False
+        self.restart_count = target
+        ctrl.set(f"agent/gen{target}/ready/{self.spec.node_rank}", b"1")
+        try:
+            ctrl.wait(
+                [
+                    f"agent/gen{target}/ready/{n}"
+                    for n in range(self.spec.nnodes)
+                ],
+                120.0,
+            )
+        except Exception:
+            ctrl.set("agent/fatal", b"1")
+            return False
+        return self._peek(ctrl, "agent/fatal") is None
 
     # -- run with restarts (api.py:952-970) -------------------------------
     def run(self) -> RunResult:
@@ -161,21 +277,39 @@ class LocalElasticAgent:
                     )
                 # failure: tear down the whole gang and re-rendezvous
                 self._stop_workers()
-                if self.restart_count >= self.spec.max_restarts:
-                    return RunResult(
-                        WorkerState.FAILED,
-                        self.restart_count,
-                        {w.local_rank: w.proc.returncode for w in self._workers},
-                    )
-                self.restart_count += 1
-                # fresh store per generation: stale barrier/worker-count keys
-                # from the failed generation must not leak into the new one
-                if self._store is not None:
-                    self._store.close()
-                    self._store = None
+                if self.spec.nnodes > 1:
+                    if not self._restart_barrier():
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    # store stays up (peers reconnect); workers namespace
+                    # their keys by TDX_RESTART_COUNT so generations can't
+                    # collide
+                else:
+                    if self.restart_count >= self.spec.max_restarts:
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    self.restart_count += 1
+                    # fresh store per generation: stale barrier/worker-count
+                    # keys from the failed generation must not leak into the
+                    # new one
+                    if self._store is not None:
+                        self._store.close()
+                        self._store = None
                 self._start_workers()
         finally:
             self._stop_workers()
+            if self._ctrl is not None and self._ctrl is not self._store:
+                try:
+                    self._ctrl.close()
+                except Exception:
+                    pass
+                self._ctrl = None
             if self._store is not None:
                 self._store.close()
                 self._store = None

@@ -25,13 +25,27 @@ from .agent import LocalElasticAgent, WorkerSpec, WorkerState
 def parse_args(argv=None):
     p = argparse.ArgumentParser(prog="tpurun")
     p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (torchrun --nnodes)")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this node's rank; node 0 hosts the rendezvous store")
     p.add_argument("--max-restarts", type=int, default=3)
     p.add_argument("--monitor-interval", type=float, default=0.1)
     p.add_argument("--master-addr", type=str, default="127.0.0.1")
     p.add_argument("--master-port", type=int, default=0)
+    p.add_argument("--rdzv-endpoint", type=str, default=None,
+                   help="host[:port] of the rendezvous store (alias for "
+                        "--master-addr/--master-port; port defaults to "
+                        "29500). Multi-node: port+1 on the same host must "
+                        "also be reachable (jax coordination service)")
+    p.add_argument("--standalone", action="store_true",
+                   help="single-node ephemeral rendezvous (torchrun "
+                        "--standalone): ignore any rdzv endpoint")
     p.add_argument("--log-dir", type=str, default=None)
     p.add_argument("--no-python", action="store_true",
                    help="entrypoint is a raw command, not a python script")
+    p.add_argument("-m", "--module", action="store_true",
+                   help="entrypoint is a module name (python -m ...)")
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
@@ -41,14 +55,35 @@ def main(argv=None) -> int:
     if not args.entrypoint:
         print("tpurun: missing entrypoint script", file=sys.stderr)
         return 2
+    master_addr, master_port = args.master_addr, args.master_port
+    if args.standalone:
+        args.nnodes, args.node_rank = 1, 0
+        master_addr, master_port = "127.0.0.1", 0
+    elif args.rdzv_endpoint:
+        if ":" in args.rdzv_endpoint:
+            host, _, port = args.rdzv_endpoint.rpartition(":")
+            try:
+                master_addr, master_port = host, int(port)
+            except ValueError:
+                print(
+                    f"tpurun: invalid --rdzv-endpoint {args.rdzv_endpoint!r} "
+                    "(expected host[:port])",
+                    file=sys.stderr,
+                )
+                return 2
+        else:
+            master_addr, master_port = args.rdzv_endpoint, 29500
     spec = WorkerSpec(
         entrypoint=args.entrypoint,
         nproc_per_node=args.nproc_per_node,
+        nnodes=args.nnodes,
+        node_rank=args.node_rank,
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
-        master_addr=args.master_addr,
-        master_port=args.master_port,
+        master_addr=master_addr,
+        master_port=master_port,
         raw_cmd=args.no_python,
+        module=args.module,
     )
     result = LocalElasticAgent(spec, log_dir=args.log_dir).run()
     if result.state is WorkerState.SUCCEEDED:

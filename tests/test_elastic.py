@@ -163,3 +163,167 @@ class TestRunCLI:
         )
         assert out.returncode == 2
         assert "missing entrypoint" in out.stderr
+
+
+class TestMultiNodeLaunch:
+    """torchrun --nnodes/--node-rank parity: two agents on one host play
+    two nodes; global RANK/WORLD_SIZE spans both; node 0 hosts the store;
+    workers bring up jax.distributed from TDX_JAX_COORDINATOR and run a
+    real cross-process collective through init_process_group(env://)."""
+
+    def test_two_node_launch_end_to_end(self, tmp_path):
+        import threading
+
+        from tests._mp_util import free_port
+
+        script = _write(
+            tmp_path,
+            "worker.py",
+            """
+            import os
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+
+            import numpy as np
+            import pytorch_distributed_example_tpu as tdx
+
+            # env:// + TDX_JAX_COORDINATOR: init_process_group brings up
+            # jax.distributed itself (launcher contract)
+            tdx.init_process_group(backend="xla", init_method="env://")
+            rank, world = tdx.get_rank(), tdx.get_world_size()
+            assert world == 2, world
+            assert rank == int(os.environ["RANK"])
+            t = tdx.DistTensor.from_process_local(
+                np.array([rank + 1.0], np.float32))
+            tdx.all_reduce(t)
+            assert t.local_numpy()[0][0] == 3.0, t.local_numpy()
+            tdx.destroy_process_group()
+            """,
+        )
+        port = free_port()
+        results = {}
+
+        def node(node_rank):
+            spec = WorkerSpec(
+                entrypoint=[script],
+                nproc_per_node=1,
+                nnodes=2,
+                node_rank=node_rank,
+                master_port=port,
+                max_restarts=0,
+                env={
+                    "PYTHONPATH": REPO
+                    + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                    # one CPU device per process; don't inherit pytest's
+                    # 8-device override
+                    "XLA_FLAGS": "",
+                },
+            )
+            results[node_rank] = LocalElasticAgent(
+                spec, log_dir=str(tmp_path / f"logs{node_rank}")
+            ).run()
+
+        threads = [threading.Thread(target=node, args=(n,)) for n in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for n in (0, 1):
+            assert results[n].state is WorkerState.SUCCEEDED, (
+                n,
+                results[n],
+                [
+                    open(os.path.join(str(tmp_path / f"logs{n}"), f)).read()[-1500:]
+                    for f in os.listdir(str(tmp_path / f"logs{n}"))
+                ],
+            )
+
+    def test_node_rank_nonzero_requires_port(self):
+        spec = WorkerSpec(entrypoint=["x.py"], nnodes=2, node_rank=1, master_port=0)
+        agent = LocalElasticAgent(spec)
+        with pytest.raises(ValueError, match="explicit master/rdzv port"):
+            agent._ensure_store()
+
+    def test_cli_flags_parse(self):
+        from pytorch_distributed_example_tpu.elastic.run import parse_args
+
+        a = parse_args(
+            [
+                "--nnodes", "4", "--node-rank", "2",
+                "--rdzv-endpoint", "10.0.0.1:29500",
+                "--nproc-per-node", "8", "-m", "train.main", "--lr", "0.1",
+            ]
+        )
+        assert a.nnodes == 4 and a.node_rank == 2
+        assert a.rdzv_endpoint == "10.0.0.1:29500"
+        assert a.module and a.entrypoint == ["train.main", "--lr", "0.1"]
+
+    def test_multi_node_restart_propagates(self, tmp_path):
+        """A worker failure on ONE node must restart the WHOLE cluster
+        (peers' workers are wedged in dead collectives); the gang succeeds
+        on the retry and both agents agree on the generation."""
+        import threading
+
+        from tests._mp_util import free_port
+
+        marker = tmp_path / "first_attempt_done"
+        script = _write(
+            tmp_path,
+            "worker.py",
+            """
+            import os, sys
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+
+            import numpy as np
+            import pytorch_distributed_example_tpu as tdx
+
+            marker = os.environ["FAIL_MARKER"]
+            rank = int(os.environ["RANK"])
+            if rank == 1 and not os.path.exists(marker):
+                open(marker, "w").write("x")
+                sys.exit(7)  # first attempt: node 1's worker dies
+
+            tdx.init_process_group(backend="xla", init_method="env://")
+            t = tdx.DistTensor.from_process_local(
+                np.array([tdx.get_rank() + 1.0], np.float32))
+            tdx.all_reduce(t)
+            assert t.local_numpy()[0][0] == 3.0
+            tdx.destroy_process_group()
+            """,
+        )
+        port = free_port()
+        results = {}
+
+        def node(node_rank):
+            spec = WorkerSpec(
+                entrypoint=[script],
+                nproc_per_node=1,
+                nnodes=2,
+                node_rank=node_rank,
+                master_port=port,
+                max_restarts=2,
+                monitor_interval_s=0.05,
+                env={
+                    "PYTHONPATH": REPO
+                    + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                    "XLA_FLAGS": "",
+                    "FAIL_MARKER": str(marker),
+                },
+            )
+            results[node_rank] = LocalElasticAgent(
+                spec, log_dir=str(tmp_path / f"rlogs{node_rank}")
+            ).run()
+
+        threads = [threading.Thread(target=node, args=(n,)) for n in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        for n in (0, 1):
+            assert results[n].state is WorkerState.SUCCEEDED, (n, results[n])
+            assert results[n].restarts == 1, results[n]

@@ -11,7 +11,6 @@ is exercised end to end; everything else runs driver mode.
 """
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap

@@ -13,7 +13,6 @@ Round-1 VERDICT missing #7 named this harness as a gap.
 
 import os
 import pickle
-import socket
 import subprocess
 import sys
 import textwrap
