@@ -139,8 +139,12 @@ def _acquire_jax(max_tries: int = 3, backoff: float = 5.0):
                     os._exit(1)
 
             threading.Thread(target=_watchdog, daemon=True).start()
-            devs = jax.devices()
-            armed.set()
+            try:
+                devs = jax.devices()
+            finally:
+                # disarm on BOTH paths: a raised init must not leave the
+                # watchdog to os._exit a later successful/fallback run
+                armed.set()
             return jax, devs, errors or None
         except Exception as e:  # probe raced a dying tunnel; keep trying
             errors.append(f"attempt {attempt + 1}: {type(e).__name__}: {e}")

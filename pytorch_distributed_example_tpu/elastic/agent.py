@@ -223,7 +223,10 @@ class LocalElasticAgent:
             codes = {w.local_rank: w.proc.poll() for w in self._workers}
             if any(c is not None and c != 0 for c in codes.values()):
                 if ctrl is not None:
-                    ctrl.set("agent/restart_gen", str(self.restart_count + 1))
+                    try:
+                        ctrl.set("agent/restart_gen", str(self.restart_count + 1))
+                    except Exception:
+                        pass  # store host may be gone; barrier will decide
                 return WorkerState.FAILED
             if all(c == 0 for c in codes.values()):
                 return WorkerState.SUCCEEDED
@@ -233,6 +236,59 @@ class LocalElasticAgent:
                     return WorkerState.FAILED  # peer-signaled restart
                 if self._peek(ctrl, "agent/fatal") is not None:
                     return WorkerState.FAILED
+
+    def _await_peers_done(self) -> str:
+        """Multi-node success path: a node whose workers exited 0 must not
+        tear down (node 0 would close the shared store) while peers still
+        run — their late failure needs this node back for the restart.
+        Returns "done" | "restart" | "fatal"."""
+        ctrl = self._control()
+        if ctrl is None:
+            return "done"
+        gen = self.restart_count
+        try:
+            ctrl.set(f"agent/done/gen{gen}/node{self.spec.node_rank}", b"1")
+        except Exception:
+            return "fatal"
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            if self._peek(ctrl, "agent/fatal") is not None:
+                return "fatal"
+            g = self._peek(ctrl, "agent/restart_gen")
+            if g is not None and int(g) > self.restart_count:
+                return "restart"
+            if all(
+                self._peek(ctrl, f"agent/done/gen{gen}/node{n}") is not None
+                for n in range(self.spec.nnodes)
+            ):
+                # two-phase: the store HOST must outlive every peer's
+                # observation of the done keys — node 0 returning first
+                # would close the daemon while others still poll it
+                try:
+                    ctrl.set(
+                        f"agent/done_ack/gen{gen}/node{self.spec.node_rank}",
+                        b"1",
+                    )
+                except Exception:
+                    pass
+                if self.spec.node_rank == 0:
+                    try:
+                        ctrl.wait(
+                            [
+                                f"agent/done_ack/gen{gen}/node{n}"
+                                for n in range(self.spec.nnodes)
+                            ],
+                            60.0,
+                        )
+                    except Exception:
+                        pass  # a peer died post-done; nothing left to protect
+                return "done"
+            time.sleep(self.spec.monitor_interval_s)
+        try:
+            ctrl.set("agent/fatal", b"1")
+        except Exception:
+            pass
+        return "fatal"
 
     def _restart_barrier(self) -> bool:
         """Multi-node: agree on the new generation before respawning, so
@@ -270,11 +326,21 @@ class LocalElasticAgent:
             while True:
                 state = self._monitor()
                 if state is WorkerState.SUCCEEDED:
-                    return RunResult(
-                        state,
-                        self.restart_count,
-                        {w.local_rank: w.proc.returncode for w in self._workers},
-                    )
+                    verdict = self._await_peers_done()
+                    if verdict == "done":
+                        return RunResult(
+                            state,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    if verdict == "fatal":
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    # "restart": a peer failed after our success — rejoin
+                    # the gang for the next generation
                 # failure: tear down the whole gang and re-rendezvous
                 self._stop_workers()
                 if self.spec.nnodes > 1:

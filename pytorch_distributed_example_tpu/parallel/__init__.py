@@ -11,7 +11,13 @@ from .localsgd import (  # noqa: F401
     unstack_replicas,
 )
 from . import sharding  # noqa: F401
-from .fsdp import FSDPModule, fully_shard, make_fsdp_train_step, shard_optimizer_only  # noqa: F401
+from .fsdp import (  # noqa: F401
+    FSDPModule,
+    fully_shard,
+    make_fsdp_train_step,
+    make_zero2_train_step,
+    shard_optimizer_only,
+)
 from .tensor_parallel import (  # noqa: F401
     ColwiseParallel,
     RowwiseParallel,

@@ -149,6 +149,85 @@ def make_fsdp_train_step(
     return jitted
 
 
+def make_zero2_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    mesh,
+    axis: str = "fsdp",
+    data_axes: Sequence[str] = ("dp", "fsdp"),
+    has_rng: bool = False,
+    remat: bool = False,
+    donate: bool = True,
+):
+    """ZeRO-2: params REPLICATED, gradients + optimizer state SHARDED.
+
+    Parity: DeepSpeed/torch ZeRO stage 2 (grad partitioning on top of
+    ZeRO-1's optimizer-state partitioning). GSPMD shape: the backward's
+    gradients are constrained dim-0 sharded over ``axis`` — the SPMD
+    partitioner lowers the grad reduction to reduce-scatter instead of
+    all-reduce — the optimizer update runs on the 1/W shard, and adding
+    the (sharded) updates back to the replicated params makes XLA emit
+    exactly one all-gather of the UPDATES. Per-step wire cost equals
+    DDP's allreduce (reduce-scatter + all-gather), but optimizer math
+    and its state are 1/W per device.
+
+    Pair with `shard_optimizer_only(opt_state, mesh, axis)` for the
+    initial opt-state placement.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    data_axes = tuple(a for a in data_axes if a in dict(jmesh.shape))
+    if not data_axes:
+        raise ValueError(
+            f"none of data_axes present in mesh axes {tuple(dict(jmesh.shape))}"
+        )
+    batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
+    rules = shd.fsdp_rules(axis)
+
+    def constrain_dim0(tree):
+        def one(leaf):
+            if not hasattr(leaf, "ndim") or leaf.ndim < 1:
+                return leaf
+            spec = shd.spec_for("zero2", tuple(leaf.shape), rules, jmesh)
+            return lax.with_sharding_constraint(
+                leaf, NamedSharding(jmesh, spec)
+            )
+
+        return jax.tree_util.tree_map(one, tree)
+
+    def step(params, opt_state, x, y, *rng):
+        def objective(p):
+            if has_rng:
+                fwd = lambda pp: apply_fn(pp, x, rngs={"dropout": rng[0]})
+            else:
+                fwd = lambda pp: apply_fn(pp, x)
+            if remat:
+                fwd = jax.checkpoint(fwd)
+            return loss_fn(fwd(p), y)
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        grads = constrain_dim0(grads)  # -> reduce-scatter, not all-reduce
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        opt_state = constrain_dim0(opt_state)  # state stays 1/W per device
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        # replicated output -> one all-gather of the updates
+        params = shd.constrain(params, jmesh, shd.replicated_specs(params))
+        return params, opt_state, loss
+
+    rep = NamedSharding(jmesh, P())
+    xshard = NamedSharding(jmesh, batch_spec)
+    return jax.jit(
+        step,
+        in_shardings=(rep, None, xshard, xshard) + ((rep,) if has_rng else ()),
+        out_shardings=(rep, None, rep),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
 def shard_optimizer_only(opt_state, mesh, axis: str = "fsdp"):
     """ZeRO-1 layout for the optimizer state: shard its array leaves dim-0
     over ``axis``. Params are untouched (keep them replicated, e.g. via

@@ -327,3 +327,54 @@ class TestMultiNodeLaunch:
         for n in (0, 1):
             assert results[n].state is WorkerState.SUCCEEDED, (n, results[n])
             assert results[n].restarts == 1, results[n]
+
+    def test_peer_failure_after_local_success_rejoins(self, tmp_path):
+        """A node whose workers already exited 0 must wait on the control
+        plane and REJOIN the gang when a peer fails afterwards (it cannot
+        tear down the shared store under the restart)."""
+        import threading
+
+        from tests._mp_util import free_port
+
+        marker = tmp_path / "late_fail_done"
+        script = _write(
+            tmp_path,
+            "worker.py",
+            """
+            import os, sys, time
+            rank = int(os.environ["RANK"])
+            marker = os.environ["FAIL_MARKER"]
+            if rank == 0:
+                sys.exit(0)  # node 0 finishes instantly, every generation
+            # node 1: fail AFTER node 0 succeeded (gen 0 only)
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                time.sleep(1.5)
+                sys.exit(9)
+            sys.exit(0)
+            """,
+        )
+        port = free_port()
+        results = {}
+
+        def node(node_rank):
+            spec = WorkerSpec(
+                entrypoint=[script],
+                nproc_per_node=1,
+                nnodes=2,
+                node_rank=node_rank,
+                master_port=port,
+                max_restarts=2,
+                monitor_interval_s=0.05,
+                env={"FAIL_MARKER": str(marker)},
+            )
+            results[node_rank] = LocalElasticAgent(spec).run()
+
+        threads = [threading.Thread(target=node, args=(n,)) for n in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for n in (0, 1):
+            assert results[n].state is WorkerState.SUCCEEDED, (n, results[n])
+            assert results[n].restarts == 1, results[n]
