@@ -49,6 +49,7 @@ class WorkerSpec:
     module: bool = False  # entrypoint is a module name (python -m ...)
     nnodes: int = 1  # torchrun --nnodes
     node_rank: int = 0  # torchrun --node-rank; node 0 hosts the store
+    peer_done_timeout_s: float = 600.0  # max finish-time skew across nodes
     env: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -250,7 +251,7 @@ class LocalElasticAgent:
             ctrl.set(f"agent/done/gen{gen}/node{self.spec.node_rank}", b"1")
         except Exception:
             return "fatal"
-        deadline = time.monotonic() + 600.0
+        deadline = time.monotonic() + self.spec.peer_done_timeout_s
         while time.monotonic() < deadline:
             if self._peek(ctrl, "agent/fatal") is not None:
                 return "fatal"

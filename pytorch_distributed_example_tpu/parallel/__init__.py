@@ -32,8 +32,11 @@ from .context_parallel import (  # noqa: F401
 from .expert_parallel import make_ep_moe, moe_mlp  # noqa: F401
 from .pipeline import (  # noqa: F401
     make_pipeline_fn,
+    make_pipeline_train_fn,
     merge_microbatches,
     pipeline_apply,
+    pipeline_apply_interleaved,
+    pipeline_train_1f1b,
     split_microbatches,
     stack_stage_params,
 )
