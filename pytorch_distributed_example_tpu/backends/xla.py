@@ -99,20 +99,13 @@ class XlaBackend(Backend):
 
     def _reduce_local(self, op):
         """Returns f(x_local) -> reduced (1, *s) block, given op."""
-        import jax.numpy as jnp
         from jax import lax
 
-        if isinstance(op, _PremulSum):
-            factor = op.factor
-            return lambda x: lax.psum(x * jnp.asarray(factor, x.dtype), AXIS)
-        if op == ReduceOp.SUM:
-            return lambda x: lax.psum(x, AXIS)
-        if op == ReduceOp.AVG:
-            return lambda x: lax.pmean(x, AXIS)
-        if op == ReduceOp.MAX:
-            return lambda x: lax.pmax(x, AXIS)
-        if op == ReduceOp.MIN:
-            return lambda x: lax.pmin(x, AXIS)
+        from ..types import lower_reduce_op
+
+        lowered = lower_reduce_op(op, AXIS)
+        if lowered is not None:
+            return lowered
         # gather + local fold for PRODUCT / bitwise ops
         fold = _fold_op(op)
 

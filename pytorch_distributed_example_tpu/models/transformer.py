@@ -41,8 +41,9 @@ class TransformerConfig:
     dtype: Any = jnp.float32
     use_flash: bool = True
     remat: bool = False
-    n_experts: int = 0  # > 0 switches the MLP to a top-1 MoE (Switch-style)
+    n_experts: int = 0  # > 0 switches the MLP to a top-k MoE
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch, 2 = GShard/Mixtral-style
 
     @property
     def kv_heads(self) -> int:
@@ -150,7 +151,8 @@ class MLP(nn.Module):
 
 
 class MoE(nn.Module):
-    """Top-1 MoE MLP (Switch) — experts shardable over an ``ep`` mesh axis
+    """Top-k MoE MLP (k=1 Switch, k>1 GShard/Mixtral) — experts shardable
+    over an ``ep`` mesh axis
     via `sharding_rules(ep_axis=...)`; routing math in
     parallel/expert_parallel.moe_mlp (axis-free form here: under jit,
     GSPMD partitions the expert einsums from the param shardings).
@@ -176,6 +178,7 @@ class MoE(nn.Module):
             router,
             axis_name=None,
             capacity_factor=cfg.moe_capacity_factor,
+            k=cfg.moe_top_k,
         )
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(B, L, D)

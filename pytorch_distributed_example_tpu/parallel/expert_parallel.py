@@ -28,26 +28,46 @@ import functools
 from typing import Callable, Optional, Tuple
 
 
-def _top1_routing(logits, n_experts: int, capacity: int):
-    """Switch-style top-1 routing: returns (expert_idx, gate, position,
-    keep_mask, aux_loss). Position = slot inside the expert's capacity
-    buffer; tokens past capacity are dropped (gate 0)."""
+def _topk_routing(logits, n_experts: int, capacity: int, k: int = 1):
+    """Token-choice top-k routing (Switch k=1, GShard/Mixtral k>1).
+
+    Returns ((T, k) expert_idx, (T, k) gate, (T, k) position, (T, k) keep,
+    aux_loss). Position = slot inside the expert's capacity buffer.
+    Capacity is assigned choice-major (every token's 1st choice before any
+    2nd choice — GShard's priority order), so over-capacity drops hit
+    lower-priority choices first. Gates: k=1 keeps the raw softmax prob
+    (Switch); k>1 renormalizes the top-k probs to sum to 1 (Mixtral).
+    Aux is the Switch load-balance loss E * sum_e f_e * P_e with f_e the
+    first-choice token fraction."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
-    T = logits.shape[0]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
-    gate = jnp.max(probs, axis=-1)  # (T,)
-    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    topv, topi = lax.top_k(probs, k)  # (T, k)
+    if k > 1:
+        gate = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    else:
+        gate = topv
 
-    # position of each token within its expert's buffer (prefix count)
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)  # (T, E)
-    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where routed
-    position = jnp.sum(pos_in_expert, axis=-1) - 1  # (T,) 0-based
-    keep = position < capacity
+    experts, positions, keeps = [], [], []
+    offsets = jnp.zeros((n_experts,), jnp.int32)  # slots used by higher prio
+    for j in range(k):
+        onehot = jax.nn.one_hot(topi[:, j], n_experts, dtype=jnp.int32)
+        pos_1b = offsets[None, :] + jnp.cumsum(onehot, axis=0)  # 1-based
+        position = jnp.sum(pos_1b * onehot, axis=-1) - 1  # (T,) 0-based
+        experts.append(topi[:, j])
+        positions.append(position)
+        keeps.append(position < capacity)
+        offsets = offsets + jnp.sum(onehot, axis=0)
 
-    # Switch load-balance loss: E * sum_e f_e * P_e
-    frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    expert = jnp.stack(experts, axis=1)  # (T, k)
+    position = jnp.stack(positions, axis=1)
+    keep = jnp.stack(keeps, axis=1)
+
+    # Switch load-balance loss on the FIRST choice
+    onehot1 = jax.nn.one_hot(topi[:, 0], n_experts, dtype=jnp.float32)
+    frac_tokens = jnp.mean(onehot1, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = n_experts * jnp.sum(frac_tokens * frac_probs)
     return expert, gate, position, keep, aux
@@ -61,10 +81,12 @@ def moe_mlp(
     axis_name: Optional[str] = "ep",
     capacity_factor: float = 1.25,
     act: Optional[Callable] = None,
+    k: int = 1,
 ):
-    """Top-1 MoE MLP. Inside shard_map: x (T_local, D) per rank, w_up/w_down
-    the rank's LOCAL experts (E_local, D, F) / (E_local, F, D); router_w
-    (D, E_global) replicated. Outside (axis_name=None): all experts local.
+    """Top-k MoE MLP (k=1 Switch, k>1 GShard/Mixtral). Inside shard_map:
+    x (T_local, D) per rank, w_up/w_down the rank's LOCAL experts
+    (E_local, D, F) / (E_local, F, D); router_w (D, E_global) replicated.
+    Outside (axis_name=None): all experts local.
 
     Returns (y, aux_loss).
     """
@@ -82,15 +104,17 @@ def moe_mlp(
     E = E_local * ep
 
     logits = jnp.dot(x, router_w, preferred_element_type=jnp.float32)  # (T, E)
-    capacity = max(1, int(capacity_factor * T / E))
-    expert, gate, position, keep, aux = _top1_routing(logits, E, capacity)
+    capacity = max(1, int(capacity_factor * k * T / E))
+    expert, gate, position, keep, aux = _topk_routing(logits, E, capacity, k)
 
-    # scatter tokens into per-expert capacity buffers: (E, C, D).
+    # scatter tokens into per-expert capacity buffers: (E, C, D) — each
+    # token lands in up to k buffers (its top-k experts).
     # Global expert id is ep-group-major: expert e lives on rank e // E_local.
     buf = jnp.zeros((E, capacity, D), x.dtype)
     safe_pos = jnp.where(keep, position, 0)
-    buf = buf.at[expert, safe_pos].add(
-        jnp.where(keep[:, None], x, 0), mode="drop"
+    x_rep = jnp.repeat(x, k, axis=0)  # token-major (T*k, D): x[t] for each choice
+    buf = buf.at[expert.reshape(-1), safe_pos.reshape(-1)].add(
+        jnp.where(keep.reshape(-1, 1), x_rep, 0), mode="drop"
     )
 
     if axis_name is not None and ep > 1:
@@ -113,14 +137,18 @@ def moe_mlp(
         h = act(h)
         y = jnp.einsum("ecf,efd->ecd", h, w_down)
 
-    # gather back to token order, weighted by the gate
-    out = y[expert, safe_pos] * (gate * keep).astype(y.dtype)[:, None]
+    # gather back to token order, weighted gate-sum over the k choices
+    out = (y[expert, safe_pos] * (gate * keep).astype(y.dtype)[:, :, None]).sum(
+        axis=1
+    )
     if axis_name is not None and ep > 1:
         aux = lax.pmean(aux, axis_name)  # replicated aux for the loss term
     return out.astype(x.dtype), aux
 
 
-def make_ep_moe(mesh, axis_name: str = "ep", capacity_factor: float = 1.25):
+def make_ep_moe(
+    mesh, axis_name: str = "ep", capacity_factor: float = 1.25, k: int = 1
+):
     """jit-ready sharded MoE: global x (T, D), experts stacked (E, D, F)
     sharded over ``ep`` dim 0; tokens sharded over ``ep`` too."""
     import jax
@@ -131,7 +159,7 @@ def make_ep_moe(mesh, axis_name: str = "ep", capacity_factor: float = 1.25):
 
     fn = shard_map_fn(
         functools.partial(
-            moe_mlp, axis_name=axis_name, capacity_factor=capacity_factor
+            moe_mlp, axis_name=axis_name, capacity_factor=capacity_factor, k=k
         ),
         mesh=jmesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name), P()),

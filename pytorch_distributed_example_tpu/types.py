@@ -57,6 +57,32 @@ class _PremulSum:
         return ReduceOp.PREMUL_SUM
 
 
+def lower_reduce_op(op, axis_name: str):
+    """SUM-family ReduceOp -> per-shard lax collective; None otherwise.
+
+    The single home of the op→ICI-primitive lowering, shared by the eager
+    backend (`backends/xla.py`) and the differentiable collectives
+    (`nn/functional.py`). PRODUCT/bitwise ops have no ICI primitive and
+    return None — callers pick their own fallback.
+    """
+    from jax import lax
+
+    if isinstance(op, _PremulSum):
+        import jax.numpy as jnp
+
+        factor = op.factor
+        return lambda x: lax.psum(x * jnp.asarray(factor, x.dtype), axis_name)
+    if op in (ReduceOp.SUM, ReduceOp.PREMUL_SUM):  # bare PREMUL: factor 1
+        return lambda x: lax.psum(x, axis_name)
+    if op == ReduceOp.AVG:
+        return lambda x: lax.pmean(x, axis_name)
+    if op == ReduceOp.MAX:
+        return lambda x: lax.pmax(x, axis_name)
+    if op == ReduceOp.MIN:
+        return lambda x: lax.pmin(x, axis_name)
+    return None
+
+
 class OpType(enum.Enum):
     """Collective op kinds — torch c10d `Work.hpp:15-37`."""
 

@@ -48,6 +48,58 @@ class TestMoELocal:
         np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-4, atol=1e-5)
 
 
+class TestTopK:
+    def test_top2_is_gate_weighted_sum_of_two_experts(self):
+        """Ample capacity: y = g1*f_e1(x) + g2*f_e2(x), gates renormalized."""
+        import jax
+        import jax.numpy as jnp
+
+        x, w_up, w_down, router = _setup(10, T=16, E=4)
+        y, _ = moe_mlp(
+            x, w_up, w_down, router, axis_name=None, capacity_factor=16.0, k=2
+        )
+        probs = jax.nn.softmax(x @ router, axis=-1)
+        topv, topi = jax.lax.top_k(probs, 2)
+        gates = topv / topv.sum(axis=-1, keepdims=True)
+        want = []
+        for t in range(16):
+            acc = 0
+            for j in range(2):
+                e = int(topi[t, j])
+                acc = acc + float(gates[t, j]) * (
+                    jax.nn.gelu(x[t] @ w_up[e]) @ w_down[e]
+                )
+            want.append(acc)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(jnp.stack(want)), rtol=1e-4, atol=1e-5
+        )
+
+    def test_first_choice_has_capacity_priority(self):
+        """Choice-major slot assignment: when capacity is tight, surviving
+        assignments are first choices before second choices, and every kept
+        (expert, slot) pair is unique across BOTH choice ranks."""
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.parallel.expert_parallel import (
+            _topk_routing,
+        )
+
+        gen = np.random.default_rng(13)
+        logits = jnp.asarray(gen.standard_normal((32, 4)), jnp.float32)
+        expert, gate, pos, keep, _ = _topk_routing(logits, 4, capacity=8, k=2)
+        kept_first = int(keep[:, 0].sum())
+        kept_second = int(keep[:, 1].sum())
+        assert kept_first >= kept_second
+        pairs = []
+        for j in range(2):
+            sel = np.asarray(keep[:, j])
+            pairs += list(
+                zip(np.asarray(expert[:, j])[sel], np.asarray(pos[:, j])[sel])
+            )
+        assert len(set(pairs)) == len(pairs)  # no buffer slot written twice
+        assert all(s < 8 for _, s in pairs)
+
+
 class TestMoETransformer:
     def test_moe_transformer_trains(self):
         """TransformerLM with n_experts>0: forward shape, aux sown, loss falls,
@@ -148,6 +200,21 @@ class TestMoESharded:
             arr = np.asarray(g)
             assert np.isfinite(arr).all(), name
             assert np.abs(arr).sum() > 0, name
+
+    def test_top2_sharded_matches_local(self):
+        """Top-2 routing: 8-way ep dispatch == all-experts-local compute."""
+        mesh = init_device_mesh(("ep",), (8,))
+        T, E = 64, 8
+        x, w_up, w_down, router = _setup(11, T=T, E=E)
+        want, _ = moe_mlp(
+            x, w_up, w_down, router, axis_name=None, capacity_factor=float(E), k=2
+        )
+        ep_fn = make_ep_moe(mesh, "ep", capacity_factor=float(E), k=2)
+        got, aux = ep_fn(x, w_up, w_down, router)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+        assert np.isfinite(float(aux))
 
     def test_capacity_drops_tokens(self):
         """Tiny capacity must produce zero output rows for dropped tokens."""
