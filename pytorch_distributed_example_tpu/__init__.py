@@ -76,6 +76,15 @@ from .parallel.join import Join, Joinable  # noqa: F401
 from .parallel.reducer import Reducer  # noqa: F401
 from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
 from . import nn  # noqa: F401  (differentiable collectives: tdx.nn.functional)
+from .dtensor import (  # noqa: F401
+    DTensor,
+    Partial,
+    Replicate,
+    Shard,
+    distribute_module,
+    distribute_tensor,
+    unwrap_module,
+)
 from .checkpoint_sharded import DCPCheckpointer, dcp_load, dcp_save  # noqa: F401
 
 __version__ = "0.1.0"

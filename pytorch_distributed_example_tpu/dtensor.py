@@ -1,0 +1,399 @@
+"""DTensor — `torch.distributed.tensor` parity over `NamedSharding`.
+
+Parity surface: torch DTensor (`torch/distributed/tensor/_api.py`:
+`distribute_tensor`, `distribute_module`, `DTensor.from_local`,
+`.to_local`, `.full_tensor`, `.redistribute`) with the placement algebra
+`Shard(dim)` / `Replicate()` / `Partial(reduce_op)`.
+
+TPU-native design: a DTensor here is a thin record around a GLOBAL
+`jax.Array` carrying a `NamedSharding` — placements translate 1:1 into a
+`PartitionSpec` (one placement per mesh axis, exactly torch's layout
+convention), and redistribution is `jax.device_put` to the new sharding,
+which XLA lowers to the matching collective (all_gather for
+Shard→Replicate, slice for Replicate→Shard, all_to_all for Shard→Shard).
+`Partial` — torch's "each device holds an unreduced addend" state — has
+no `jax.Array` analog, so it is carried as an explicit pending stack: an
+array with a leading mesh-axis dimension, reduced on the way out
+(psum for →Replicate, reduce-scatter for →Shard). Arithmetic on DTensors
+applies the op to the global arrays and reads the result sharding back
+from XLA's propagation — op dispatch IS the sharding propagator here,
+rather than torch's per-op DTensor dispatch table
+(`torch/distributed/tensor/_dispatch.py`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from .types import ReduceOp
+
+
+# ---------------------------------------------------------------------------
+# placements
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Shard:
+    """Tensor dim `dim` is split over the corresponding mesh axis."""
+
+    dim: int
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+@dataclass(frozen=True)
+class Replicate:
+    """Tensor is replicated along the corresponding mesh axis."""
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+@dataclass(frozen=True)
+class Partial:
+    """Each position along the mesh axis holds an unreduced addend."""
+
+    reduce_op: Any = ReduceOp.SUM  # ReduceOp | _PremulSum
+
+    def __repr__(self):
+        name = getattr(self.reduce_op, "name", None) or repr(self.reduce_op)
+        return f"Partial({name})"
+
+
+Placement = Any  # Shard | Replicate | Partial
+
+
+def _reduce_stack(a, op):
+    """Reduce a pending Partial stack (dim 0) with the full ReduceOp
+    algebra; unsupported ops raise instead of silently summing."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from .types import _PremulSum
+
+    if isinstance(op, _PremulSum):
+        return (a * jnp.asarray(op.factor, a.dtype)).sum(axis=0)
+    table = {
+        ReduceOp.SUM: lambda: a.sum(axis=0),
+        ReduceOp.PREMUL_SUM: lambda: a.sum(axis=0),  # bare: factor 1
+        ReduceOp.AVG: lambda: a.mean(axis=0),
+        ReduceOp.MAX: lambda: a.max(axis=0),
+        ReduceOp.MIN: lambda: a.min(axis=0),
+        ReduceOp.PRODUCT: lambda: a.prod(axis=0),
+        ReduceOp.BAND: lambda: functools.reduce(
+            jnp.bitwise_and, [a[i] for i in range(a.shape[0])]
+        ),
+        ReduceOp.BOR: lambda: functools.reduce(
+            jnp.bitwise_or, [a[i] for i in range(a.shape[0])]
+        ),
+        ReduceOp.BXOR: lambda: functools.reduce(
+            jnp.bitwise_xor, [a[i] for i in range(a.shape[0])]
+        ),
+    }
+    if op not in table:
+        raise ValueError(f"unsupported Partial reduce op {op}")
+    return table[op]()
+
+
+def _normalize(placements, mesh) -> Tuple[Placement, ...]:
+    axes = mesh.axis_names
+    placements = tuple(placements)
+    if len(placements) != len(axes):
+        raise ValueError(
+            f"need one placement per mesh axis {tuple(axes)}, got {placements}"
+        )
+    seen = {}
+    for ax, p in zip(axes, placements):
+        if isinstance(p, Shard):
+            if p.dim in seen:
+                raise NotImplementedError(
+                    f"tensor dim {p.dim} sharded by both {seen[p.dim]!r} and "
+                    f"{ax!r}; multi-axis sharding of one dim is unsupported"
+                )
+            seen[p.dim] = ax
+    return placements
+
+
+def _to_spec(placements, mesh):
+    """Placements -> PartitionSpec (torch layout convention -> GSPMD)."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = mesh.axis_names
+    dim_to_axis = {}
+    for ax, p in zip(axes, placements):
+        if isinstance(p, Shard):
+            dim_to_axis[p.dim] = ax
+    if not dim_to_axis:
+        return P()
+    ndim = max(dim_to_axis) + 1
+    return P(*[dim_to_axis.get(d) for d in range(ndim)])
+
+
+# ---------------------------------------------------------------------------
+# DTensor
+# ---------------------------------------------------------------------------
+
+
+class DTensor:
+    """Global-view distributed tensor (see module docstring).
+
+    `_partial_axes` lists mesh axes whose placement is Partial; for those,
+    `_array` carries one leading dim PER partial axis (in mesh-axis order)
+    holding the unreduced addends, and the logical shape excludes them.
+    """
+
+    def __init__(self, array, mesh, placements, _partial_axes=()):
+        self._array = array
+        self._mesh = mesh
+        self._placements = tuple(placements)
+        self._partial_axes = tuple(_partial_axes)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def device_mesh(self):
+        return self._mesh
+
+    @property
+    def placements(self) -> Tuple[Placement, ...]:
+        return self._placements
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        n = len(self._partial_axes)
+        return tuple(self._array.shape[n:])
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    def __repr__(self):
+        return (
+            f"DTensor(shape={self.shape}, placements={self._placements}, "
+            f"mesh={self._mesh.axis_names}x{self._mesh.shape})"
+        )
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_local(local, mesh, placements):
+        """Driver-mode `DTensor.from_local`: `local` carries one leading
+        stack dim PER non-Replicate placement, in mesh-axis order, holding
+        the per-mesh-position values — e.g. mesh ("dp",) of 8 with
+        (Shard(0),) on a global (32, d) tensor: local is (8, 4, d).
+        Shard stacks are concatenated into the global value; Partial
+        stacks are kept pending until `redistribute` reduces them."""
+        import jax.numpy as jnp
+
+        placements = _normalize(placements, mesh)
+        sizes = dict(zip(mesh.axis_names, mesh.shape))
+        a = jnp.asarray(local)
+        active = [
+            (ax, p)
+            for ax, p in zip(mesh.axis_names, placements)
+            if not isinstance(p, Replicate)
+        ]
+        partial_axes = []
+        kept = 0  # leading dims kept so far (pending Partial stacks)
+        for idx, (ax, p) in enumerate(active):
+            if a.shape[kept] != sizes[ax]:
+                raise ValueError(
+                    f"stack dim for axis {ax!r} has size {a.shape[kept]}, "
+                    f"expected {sizes[ax]}"
+                )
+            if isinstance(p, Partial):
+                partial_axes.append(ax)
+                kept += 1
+                continue
+            # Shard: consume the stack dim at position `kept`. After it is
+            # removed, tensor dim p.dim sits past the kept Partial stacks
+            # AND the still-unconsumed stack dims of later mesh axes.
+            remaining = len(active) - idx - 1
+            moved = jnp.moveaxis(a, kept, 0)
+            a = jnp.concatenate(
+                [moved[i] for i in range(sizes[ax])],
+                axis=kept + remaining + p.dim,
+            )
+        if not partial_axes:
+            return distribute_tensor(a, mesh, placements)
+        return DTensor(a, mesh, placements, tuple(partial_axes))
+
+    # -- materialization ---------------------------------------------------
+    def to_local(self):
+        """Per-position local shard(s). Driver mode controls every mesh
+        position, so this returns the addressable shards as a list keyed by
+        flat device order (c10d-rank order); replicated tensors return the
+        single global value (every position identical)."""
+        if self._partial_axes:
+            return self._array  # the pending stack IS the local view
+        if all(isinstance(p, Replicate) for p in self._placements):
+            return self._array
+        return [s.data for s in self._array.addressable_shards]
+
+    def full_tensor(self):
+        """Replicated global value (torch `full_tensor`): redistribute all
+        axes to Replicate and return the jax.Array."""
+        return self.redistribute(
+            [Replicate() for _ in self._placements]
+        )._array
+
+    def to_global(self):
+        """The underlying global jax.Array (no Partial axes resolved)."""
+        if self._partial_axes:
+            raise ValueError(
+                "DTensor has pending Partial reductions; redistribute first"
+            )
+        return self._array
+
+    # -- redistribution ----------------------------------------------------
+    def redistribute(self, placements) -> "DTensor":
+        """Change placements; XLA inserts the matching collectives."""
+        placements = _normalize(placements, self._mesh)
+        a = self._array
+        # resolve pending Partial stacks first: the stacks are the leading
+        # dims in mesh-axis order, so reduce axis 0 repeatedly
+        ops = {
+            ax: p.reduce_op
+            for ax, p in zip(self._mesh.axis_names, self._placements)
+            if isinstance(p, Partial)
+        }
+        for ax in self._partial_axes:
+            a = _reduce_stack(a, ops[ax])
+        for p in placements:
+            if isinstance(p, Partial):
+                raise NotImplementedError(
+                    "redistribute TO Partial is not supported (torch keeps "
+                    "this internal to op dispatch as well)"
+                )
+        return distribute_tensor(a, self._mesh, placements)
+
+    # -- arithmetic (sharding propagation does the dispatch) ---------------
+    def _binop(self, other, fn):
+        import jax
+
+        if isinstance(other, DTensor):
+            if other._mesh is not self._mesh and (
+                other._mesh.axis_names != self._mesh.axis_names
+                or other._mesh.shape != self._mesh.shape
+            ):
+                raise ValueError("cross-mesh DTensor ops are not defined")
+            other = other.to_global()
+        out = fn(self.to_global(), other)
+        return _wrap_from_array(out, self._mesh)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b)
+
+    def sum(self, axis=None):
+        out = self.to_global().sum(axis=axis)
+        return _wrap_from_array(out, self._mesh)
+
+
+def _placements_from_spec(spec, mesh) -> Tuple[Placement, ...]:
+    """PartitionSpec -> per-mesh-axis placements."""
+    by_axis = {}
+    spec = tuple(spec) if spec is not None else ()
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        for ax in entries:
+            by_axis[ax] = Shard(d)
+    return tuple(by_axis.get(ax, Replicate()) for ax in mesh.axis_names)
+
+
+def _wrap_from_array(arr, mesh) -> DTensor:
+    """Wrap a jax.Array, reading placements back from its sharding."""
+    from jax.sharding import NamedSharding
+
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        placements = _placements_from_spec(sh.spec, mesh)
+    else:
+        placements = tuple(Replicate() for _ in mesh.axis_names)
+    return DTensor(arr, mesh, placements)
+
+
+# ---------------------------------------------------------------------------
+# module-level API
+# ---------------------------------------------------------------------------
+
+
+def distribute_tensor(tensor, device_mesh, placements) -> DTensor:
+    """torch `distribute_tensor`: place a full tensor onto the mesh with
+    the given per-axis placements (device_put; XLA moves the bytes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    mesh = device_mesh
+    placements = _normalize(placements, mesh)
+    for p in placements:
+        if isinstance(p, Partial):
+            raise ValueError(
+                "distribute_tensor cannot create Partial placements from a "
+                "full tensor (torch raises here too); use DTensor.from_local"
+            )
+    arr = jnp.asarray(tensor)
+    spec = _to_spec(placements, mesh)
+    for ax, p in zip(mesh.axis_names, placements):
+        if isinstance(p, Shard):
+            size = dict(zip(mesh.axis_names, mesh.shape))[ax]
+            if arr.shape[p.dim] % size != 0:
+                raise ValueError(
+                    f"dim {p.dim} of size {arr.shape[p.dim]} not divisible "
+                    f"by mesh axis {ax!r} size {size}"
+                )
+    out = jax.device_put(arr, NamedSharding(mesh.jax_mesh, spec))
+    return DTensor(out, mesh, placements)
+
+
+def distribute_module(
+    params,
+    device_mesh,
+    partition_fn: Optional[Callable[[str, Any], Sequence[Placement]]] = None,
+) -> Any:
+    """torch `distribute_module` for param PYTREES (the flax-native form of
+    "module"): apply `partition_fn(path, leaf) -> placements` to every leaf
+    (None -> Replicate everywhere) and return the tree of DTensors.
+    `unwrap_module(tree)` gives back raw sharded jax.Arrays for `apply`."""
+    import jax
+
+    mesh = device_mesh
+
+    def place(path, leaf):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        placements = (
+            partition_fn(name, leaf)
+            if partition_fn is not None
+            else [Replicate() for _ in mesh.axis_names]
+        )
+        return distribute_tensor(leaf, mesh, placements)
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def unwrap_module(tree):
+    """DTensor pytree -> raw global jax.Array pytree (for model.apply)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x.to_global() if isinstance(x, DTensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, DTensor),
+    )

@@ -55,13 +55,20 @@ def all_reduce(x, op=ReduceOp.SUM, axis_name: str = "dp"):
     if lowered is not None:
         return lowered(x)
     if op == ReduceOp.PRODUCT:
-        # log-sum-exp-style lowering keeps PRODUCT differentiable for
-        # positive inputs; sign handled via parity of negatives
+        # log-abs-exp lowering keeps PRODUCT differentiable; sign handled
+        # via parity of negatives. Exact zeros would make log() emit -inf
+        # and the backward 0*inf=NaN, so zero positions are masked out of
+        # the log and the result (and its gradient) forced to 0 there —
+        # the same zero-grad-at-zero convention as the NCCL-style y/x form.
         import jax.numpy as jnp
 
-        sign = lax.psum(jnp.where(x < 0, 1, 0), axis_name) % 2
-        mag = lax.psum(jnp.log(jnp.abs(x)), axis_name)
-        return jnp.where(sign == 1, -1.0, 1.0) * jnp.exp(mag)
+        zero = x == 0
+        any_zero = lax.psum(zero.astype(jnp.int32), axis_name) > 0
+        safe = jnp.where(zero, jnp.ones_like(x), x)
+        sign = lax.psum(jnp.where(safe < 0, 1, 0), axis_name) % 2
+        mag = lax.psum(jnp.log(jnp.abs(safe)), axis_name)
+        prod = jnp.where(sign == 1, -1.0, 1.0) * jnp.exp(mag)
+        return jnp.where(any_zero, jnp.zeros_like(prod), prod)
     raise ValueError(f"unsupported differentiable reduce op {op}")
 
 

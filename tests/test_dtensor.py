@@ -1,0 +1,260 @@
+"""DTensor API tests (`torch.distributed.tensor` parity, `dtensor.py`):
+placement -> sharding translation, redistribution collectives, Partial
+reduction semantics, from_local/full_tensor round trips, arithmetic with
+sharding propagation, and distribute_module over a param pytree."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu.dtensor import (
+    DTensor,
+    Partial,
+    Replicate,
+    Shard,
+    distribute_module,
+    distribute_tensor,
+    unwrap_module,
+)
+from pytorch_distributed_example_tpu.mesh import init_device_mesh
+from pytorch_distributed_example_tpu.types import ReduceOp
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return init_device_mesh(("dp",), (W,))
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    return init_device_mesh(("dp", "tp"), (4, 2))
+
+
+def _arr(seed, shape):
+    import jax.numpy as jnp
+
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), jnp.float32
+    )
+
+
+class TestPlacement:
+    def test_shard_places_shards(self, mesh):
+        x = _arr(0, (32, 6))
+        dt = distribute_tensor(x, mesh, [Shard(0)])
+        assert dt.shape == (32, 6)
+        shards = {s.data.shape for s in dt.to_global().addressable_shards}
+        assert shards == {(4, 6)}
+
+    def test_replicate_places_copies(self, mesh):
+        x = _arr(1, (5, 3))
+        dt = distribute_tensor(x, mesh, [Replicate()])
+        assert all(
+            s.data.shape == (5, 3) for s in dt.to_global().addressable_shards
+        )
+
+    def test_2d_mesh_mixed_placements(self, mesh2d):
+        x = _arr(2, (8, 6))
+        dt = distribute_tensor(x, mesh2d, [Shard(0), Shard(1)])
+        shards = {s.data.shape for s in dt.to_global().addressable_shards}
+        assert shards == {(2, 3)}  # 8/dp=4, 6/tp=2
+        dt2 = distribute_tensor(x, mesh2d, [Replicate(), Shard(1)])
+        assert {s.data.shape for s in dt2.to_global().addressable_shards} == {
+            (8, 3)
+        }
+
+    def test_same_dim_two_axes_rejected(self, mesh2d):
+        with pytest.raises(NotImplementedError):
+            distribute_tensor(_arr(3, (8, 6)), mesh2d, [Shard(0), Shard(0)])
+
+    def test_indivisible_rejected(self, mesh):
+        with pytest.raises(ValueError):
+            distribute_tensor(_arr(4, (9, 2)), mesh, [Shard(0)])
+
+    def test_partial_rejected_from_full_tensor(self, mesh):
+        with pytest.raises(ValueError):
+            distribute_tensor(_arr(5, (8, 2)), mesh, [Partial()])
+
+
+class TestRedistribute:
+    def test_shard_to_replicate_and_back(self, mesh):
+        x = _arr(6, (32, 4))
+        dt = distribute_tensor(x, mesh, [Shard(0)])
+        rep = dt.redistribute([Replicate()])
+        np.testing.assert_allclose(np.asarray(rep.to_global()), np.asarray(x))
+        back = rep.redistribute([Shard(0)])
+        assert {s.data.shape for s in back.to_global().addressable_shards} == {
+            (4, 4)
+        }
+        # dim 1 (size 4) cannot split over 8 devices: loud error, not silence
+        with pytest.raises(ValueError):
+            rep.redistribute([Shard(1)])
+
+    def test_shard_dim_change(self, mesh):
+        x = _arr(7, (16, 8))
+        dt = distribute_tensor(x, mesh, [Shard(0)])
+        dt2 = dt.redistribute([Shard(1)])
+        assert {s.data.shape for s in dt2.to_global().addressable_shards} == {
+            (16, 1)
+        }
+        np.testing.assert_allclose(np.asarray(dt2.full_tensor()), np.asarray(x))
+
+    def test_full_tensor_equals_source(self, mesh2d):
+        x = _arr(8, (12, 4))
+        dt = distribute_tensor(x, mesh2d, [Shard(1), Replicate()])
+        np.testing.assert_allclose(np.asarray(dt.full_tensor()), np.asarray(x))
+
+
+class TestPartial:
+    def test_partial_sum_reduces_on_redistribute(self, mesh):
+        import jax.numpy as jnp
+
+        stack = _arr(9, (W, 4, 3))  # one addend per dp position
+        dt = DTensor.from_local(stack, mesh, [Partial()])
+        assert dt.shape == (4, 3)
+        rep = dt.redistribute([Replicate()])
+        np.testing.assert_allclose(
+            np.asarray(rep.to_global()),
+            np.asarray(stack.sum(axis=0)),
+            rtol=1e-5,
+        )
+
+    def test_partial_avg_and_max(self, mesh):
+        stack = _arr(10, (W, 2, 2))
+        avg = DTensor.from_local(
+            stack, mesh, [Partial(ReduceOp.AVG)]
+        ).redistribute([Replicate()])
+        np.testing.assert_allclose(
+            np.asarray(avg.to_global()), np.asarray(stack.mean(axis=0)), rtol=1e-5
+        )
+        mx = DTensor.from_local(
+            stack, mesh, [Partial(ReduceOp.MAX)]
+        ).redistribute([Replicate()])
+        np.testing.assert_allclose(
+            np.asarray(mx.to_global()), np.asarray(stack.max(axis=0)), rtol=1e-6
+        )
+
+    def test_partial_to_shard_is_reduce_scatter(self, mesh):
+        stack = _arr(11, (W, 16, 2))
+        dt = DTensor.from_local(stack, mesh, [Partial()])
+        sh = dt.redistribute([Shard(0)])
+        assert {s.data.shape for s in sh.to_global().addressable_shards} == {
+            (2, 2)
+        }
+        np.testing.assert_allclose(
+            np.asarray(sh.full_tensor()), np.asarray(stack.sum(axis=0)), rtol=1e-5
+        )
+
+    def test_to_global_raises_with_pending_partial(self, mesh):
+        dt = DTensor.from_local(_arr(12, (W, 2)), mesh, [Partial()])
+        with pytest.raises(ValueError):
+            dt.to_global()
+
+
+class TestFromLocal:
+    def test_from_local_shard_round_trip(self, mesh):
+        x = _arr(13, (32, 5))
+        stack = np.stack(np.split(np.asarray(x), W, axis=0))  # (8, 4, 5)
+        dt = DTensor.from_local(stack, mesh, [Shard(0)])
+        np.testing.assert_allclose(np.asarray(dt.full_tensor()), np.asarray(x))
+
+    def test_from_local_wrong_stack_size(self, mesh):
+        with pytest.raises(ValueError):
+            DTensor.from_local(_arr(14, (4, 2)), mesh, [Shard(0)])
+
+    def test_from_local_multi_axis_shard_shard(self, mesh2d):
+        """Shard before another non-Replicate placement: stack dims are
+        (dp=4, tp=2) and both must land on the right tensor dims."""
+        x = _arr(22, (8, 6))
+        # build the (4, 2, 2, 3) stack: dp splits dim0, tp splits dim1
+        stack = np.empty((4, 2, 2, 3), np.float32)
+        for i in range(4):
+            for j in range(2):
+                stack[i, j] = np.asarray(x)[i * 2 : (i + 1) * 2, j * 3 : (j + 1) * 3]
+        dt = DTensor.from_local(stack, mesh2d, [Shard(0), Shard(1)])
+        np.testing.assert_allclose(np.asarray(dt.full_tensor()), np.asarray(x))
+
+    def test_from_local_shard_then_partial(self, mesh2d):
+        """Shard(dp) + Partial(tp): the shard concat must skip the pending
+        Partial stack dim."""
+        gen = np.random.default_rng(23)
+        stack = np.asarray(gen.standard_normal((4, 2, 2, 3)), np.float32)
+        dt = DTensor.from_local(stack, mesh2d, [Shard(0), Partial()])
+        assert dt.shape == (8, 3)
+        rep = dt.redistribute([Replicate(), Replicate()])
+        want = np.concatenate([stack[i].sum(axis=0) for i in range(4)], axis=0)
+        np.testing.assert_allclose(
+            np.asarray(rep.to_global()), want, rtol=1e-5
+        )
+
+    def test_partial_product_and_unsupported(self, mesh):
+        stack = _arr(24, (W, 3, 2))
+        prod = DTensor.from_local(
+            stack, mesh, [Partial(ReduceOp.PRODUCT)]
+        ).redistribute([Replicate()])
+        np.testing.assert_allclose(
+            np.asarray(prod.to_global()),
+            np.asarray(stack).prod(axis=0),
+            rtol=1e-4,
+        )
+        premul = DTensor.from_local(
+            stack, mesh, [Partial(ReduceOp.PREMUL_SUM(0.5))]
+        ).redistribute([Replicate()])
+        np.testing.assert_allclose(
+            np.asarray(premul.to_global()),
+            0.5 * np.asarray(stack).sum(axis=0),
+            rtol=1e-5,
+        )
+
+
+class TestArithmetic:
+    def test_add_preserves_sharding(self, mesh):
+        x, y = _arr(15, (16, 4)), _arr(16, (16, 4))
+        a = distribute_tensor(x, mesh, [Shard(0)])
+        b = distribute_tensor(y, mesh, [Shard(0)])
+        c = a + b
+        assert isinstance(c, DTensor)
+        assert c.placements == (Shard(0),)
+        np.testing.assert_allclose(
+            np.asarray(c.full_tensor()), np.asarray(x + y), rtol=1e-6
+        )
+
+    def test_matmul_and_scalar(self, mesh):
+        x = _arr(17, (16, 8))
+        w = _arr(18, (8, 4))
+        a = distribute_tensor(x, mesh, [Shard(0)])
+        b = distribute_tensor(w, mesh, [Replicate()])
+        c = (2.0 * a) @ b
+        np.testing.assert_allclose(
+            np.asarray(c.full_tensor()), np.asarray(2.0 * x @ w), rtol=1e-4
+        )
+
+
+class TestDistributeModule:
+    def test_param_tree_placement_and_unwrap(self, mesh2d):
+        import jax.numpy as jnp
+
+        params = {
+            "dense": {"kernel": _arr(19, (8, 6)), "bias": _arr(20, (6,))},
+            "head": {"kernel": _arr(21, (6, 4))},
+        }
+
+        def partition(name, leaf):
+            if name.endswith("kernel") and leaf.ndim == 2:
+                return [Replicate(), Shard(1)]
+            return [Replicate(), Replicate()]
+
+        tree = distribute_module(params, mesh2d, partition)
+        assert isinstance(tree["dense"]["kernel"], DTensor)
+        assert tree["dense"]["kernel"].placements == (Replicate(), Shard(1))
+        assert tree["dense"]["bias"].placements == (Replicate(), Replicate())
+
+        raw = unwrap_module(tree)
+        np.testing.assert_allclose(
+            np.asarray(raw["dense"]["kernel"]),
+            np.asarray(params["dense"]["kernel"]),
+        )
+        assert {
+            s.data.shape for s in raw["dense"]["kernel"].addressable_shards
+        } == {(8, 3)}

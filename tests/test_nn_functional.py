@@ -95,6 +95,21 @@ class TestAllReduce:
         g = jax.grad(lambda x: f(x).sum())(x)
         assert np.isfinite(np.asarray(g)).all()
 
+    def test_product_zero_input_keeps_grads_finite(self, mesh):
+        """Exact zeros must not poison the backward with log(0) NaNs; the
+        convention is zero forward value AND zero gradient there."""
+        import jax
+        import jax.numpy as jnp
+
+        x = _x(20)
+        x = x.at[0, 0].set(0.0)  # rank 0's block gets an exact zero
+        f = _shard_mapped(lambda x: F.all_reduce(x, ReduceOp.PRODUCT, "dp"), mesh)
+        y = np.asarray(f(x)).reshape(W, -1, x.shape[1])
+        assert y[0, 0, 0] == 0.0
+        g = np.asarray(jax.grad(lambda x: f(x).sum())(x))
+        assert np.isfinite(g).all()
+        assert g.reshape(W, -1, x.shape[1])[0, 0, 0] == 0.0
+
 
 class TestAllGather:
     def test_grad_is_reduce_scatter_of_cotangent(self, mesh):
