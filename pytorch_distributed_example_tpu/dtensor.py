@@ -99,23 +99,38 @@ def _reduce_stack(a, op):
     return table[op]()
 
 
-def _normalize(placements, mesh) -> Tuple[Placement, ...]:
+def _normalize(placements, mesh, ndim: Optional[int] = None) -> Tuple[Placement, ...]:
+    """Validate placements; with `ndim` known, canonicalize negative
+    Shard dims (torch accepts Shard(-1)) so later spec math never sees
+    them."""
     axes = mesh.axis_names
     placements = tuple(placements)
     if len(placements) != len(axes):
         raise ValueError(
             f"need one placement per mesh axis {tuple(axes)}, got {placements}"
         )
+    out = []
     seen = {}
     for ax, p in zip(axes, placements):
         if isinstance(p, Shard):
-            if p.dim in seen:
+            dim = p.dim
+            if dim < 0:
+                if ndim is None:
+                    raise ValueError(
+                        f"negative Shard dim {dim} needs a known tensor rank"
+                    )
+                dim = dim % ndim
+                p = Shard(dim)
+            if ndim is not None and not (0 <= dim < ndim):
+                raise ValueError(f"Shard dim {p.dim} out of range for rank {ndim}")
+            if dim in seen:
                 raise NotImplementedError(
-                    f"tensor dim {p.dim} sharded by both {seen[p.dim]!r} and "
+                    f"tensor dim {dim} sharded by both {seen[dim]!r} and "
                     f"{ax!r}; multi-axis sharding of one dim is unsupported"
                 )
-            seen[p.dim] = ax
-    return placements
+            seen[dim] = ax
+        out.append(p)
+    return tuple(out)
 
 
 def _to_spec(placements, mesh):
@@ -187,9 +202,12 @@ class DTensor:
         stacks are kept pending until `redistribute` reduces them."""
         import jax.numpy as jnp
 
-        placements = _normalize(placements, mesh)
-        sizes = dict(zip(mesh.axis_names, mesh.shape))
         a = jnp.asarray(local)
+        n_stacks = sum(
+            1 for p in placements if not isinstance(p, Replicate)
+        )
+        placements = _normalize(placements, mesh, ndim=a.ndim - n_stacks)
+        sizes = dict(zip(mesh.axis_names, mesh.shape))
         active = [
             (ax, p)
             for ax, p in zip(mesh.axis_names, placements)
@@ -227,6 +245,13 @@ class DTensor:
         flat device order (c10d-rank order); replicated tensors return the
         single global value (every position identical)."""
         if self._partial_axes:
+            if any(isinstance(p, Shard) for p in self._placements):
+                # the internal array already holds GLOBAL shard dims, so
+                # there is no per-position local view to hand out honestly
+                raise ValueError(
+                    "to_local() with mixed Shard + pending Partial "
+                    "placements is ambiguous; redistribute() first"
+                )
             return self._array  # the pending stack IS the local view
         if all(isinstance(p, Replicate) for p in self._placements):
             return self._array
@@ -250,7 +275,7 @@ class DTensor:
     # -- redistribution ----------------------------------------------------
     def redistribute(self, placements) -> "DTensor":
         """Change placements; XLA inserts the matching collectives."""
-        placements = _normalize(placements, self._mesh)
+        placements = _normalize(placements, self._mesh, ndim=len(self.shape))
         a = self._array
         # resolve pending Partial stacks first: the stacks are the leading
         # dims in mesh-axis order, so reduce axis 0 repeatedly
@@ -342,14 +367,15 @@ def distribute_tensor(tensor, device_mesh, placements) -> DTensor:
     from jax.sharding import NamedSharding
 
     mesh = device_mesh
-    placements = _normalize(placements, mesh)
+    arr0 = jnp.asarray(tensor)
+    placements = _normalize(placements, mesh, ndim=arr0.ndim)
     for p in placements:
         if isinstance(p, Partial):
             raise ValueError(
                 "distribute_tensor cannot create Partial placements from a "
                 "full tensor (torch raises here too); use DTensor.from_local"
             )
-    arr = jnp.asarray(tensor)
+    arr = arr0
     spec = _to_spec(placements, mesh)
     for ax, p in zip(mesh.axis_names, placements):
         if isinstance(p, Shard):

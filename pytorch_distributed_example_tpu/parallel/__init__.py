@@ -4,6 +4,7 @@ from .join import Join, Joinable, JoinHook, join_batches  # noqa: F401
 from . import comm_hooks  # noqa: F401
 from .comm_hooks import PowerSGDHook, powerSGD_hook  # noqa: F401
 from .localsgd import (  # noqa: F401
+    HierarchicalModelAverager,
     PeriodicModelAverager,
     init_stacked_opt_state,
     make_localsgd_train_step,

@@ -253,6 +253,14 @@ def make_ddp_train_step(
     g = dist._resolve(group)
     mesh = g.mesh.jax_mesh
     axis = g.mesh.axis_names[0]
+    # ZeroRedundancyOptimizer pins state shardings via constraints, which
+    # cannot be expressed inside this step's manual shard_map region —
+    # unwrap to the raw optimizer here (state placement from zopt.init()
+    # still applies between steps)
+    from ..optim import ZeroRedundancyOptimizer
+
+    if isinstance(optimizer, ZeroRedundancyOptimizer):
+        optimizer = optimizer.optimizer
     hook = comm_hook or comm_hooks.allreduce_hook
     # Stateful hooks (PowerSGD: error feedback + warm-started Q) carry an
     # explicit state pytree through the step — torch mutates PowerSGDState

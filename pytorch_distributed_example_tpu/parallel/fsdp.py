@@ -91,32 +91,41 @@ def fully_shard(
     return FSDPModule(module, sharded, jmesh, axis, specs, present or (axis,))
 
 
-def make_fsdp_train_step(
-    apply_fn: Callable,
-    loss_fn: Callable,
-    optimizer,
-    mesh,
-    param_specs,
-    data_axes: Sequence[str] = ("dp", "fsdp"),
-    has_rng: bool = False,
-    remat: bool = False,
-    donate: bool = True,
-):
-    """Compile the FSDP train step: batch split over data axes, params
-    sharded per ``param_specs``; XLA GSPMD materializes gather/scatter.
-    """
-    import jax
-    import optax  # noqa: F401  (optimizer protocol)
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def _batch_spec(jmesh, data_axes):
+    from jax.sharding import PartitionSpec as P
 
-    jmesh = getattr(mesh, "jax_mesh", mesh)
     data_axes = tuple(a for a in data_axes if a in dict(jmesh.shape))
     if not data_axes:
         raise ValueError(
             f"none of data_axes present in mesh axes {tuple(dict(jmesh.shape))}; "
             "pass data_axes matching your mesh (e.g. data_axes=('fsdp',))"
         )
-    batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
+    return P(data_axes if len(data_axes) > 1 else data_axes[0])
+
+
+def _make_constrained_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    jmesh,
+    batch_spec,
+    constrain_grads: Callable,
+    constrain_opt_state: Optional[Callable],
+    constrain_params: Callable,
+    param_sharding,
+    has_rng: bool,
+    remat: bool,
+    donate: bool,
+):
+    """Shared fwd/bwd/update scaffold for the ZeRO family.
+
+    The stages only differ in which sharding constraints they pin on
+    grads / optimizer state / updated params (and the params' jit
+    sharding); everything else — rng threading, remat, donation — lives
+    here once.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     def step(params, opt_state, x, y, *rng):
         def objective(p):
@@ -129,24 +138,64 @@ def make_fsdp_train_step(
             return loss_fn(fwd(p), y)
 
         loss, grads = jax.value_and_grad(objective)(params)
-        # keep grads in the param layout (reduce-scatter falls out of SPMD)
-        grads = shd.constrain(grads, jmesh, param_specs)
+        grads = constrain_grads(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if constrain_opt_state is not None:
+            opt_state = constrain_opt_state(opt_state)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        params = shd.constrain(params, jmesh, param_specs)
+        params = constrain_params(params)
         return params, opt_state, loss
 
-    pshard = jax.tree_util.tree_map(lambda s: NamedSharding(jmesh, s), param_specs)
     xshard = NamedSharding(jmesh, batch_spec)
     rep = NamedSharding(jmesh, P())
-
-    jitted = jax.jit(
+    return jax.jit(
         step,
-        in_shardings=(pshard, None, xshard, xshard) + ((rep,) if has_rng else ()),
-        out_shardings=(pshard, None, rep),
+        in_shardings=(param_sharding, None, xshard, xshard)
+        + ((rep,) if has_rng else ()),
+        out_shardings=(param_sharding, None, rep),
         donate_argnums=(0, 1) if donate else (),
     )
-    return jitted
+
+
+def make_fsdp_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    mesh,
+    param_specs,
+    data_axes: Sequence[str] = ("dp", "fsdp"),
+    has_rng: bool = False,
+    remat: bool = False,
+    donate: bool = True,
+):
+    """Compile the FSDP (ZeRO-3) train step: batch split over data axes,
+    params sharded per ``param_specs``; XLA GSPMD materializes the
+    per-layer gather/scatter.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    # grads + updated params stay in the param layout (reduce-scatter
+    # falls out of SPMD)
+    in_layout = lambda tree: shd.constrain(tree, jmesh, param_specs)
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(jmesh, s), param_specs
+    )
+    return _make_constrained_train_step(
+        apply_fn,
+        loss_fn,
+        optimizer,
+        jmesh,
+        _batch_spec(jmesh, data_axes),
+        constrain_grads=in_layout,
+        constrain_opt_state=None,
+        constrain_params=in_layout,
+        param_sharding=pshard,
+        has_rng=has_rng,
+        remat=remat,
+        donate=donate,
+    )
 
 
 def make_zero2_train_step(
@@ -175,56 +224,27 @@ def make_zero2_train_step(
     Pair with `shard_optimizer_only(opt_state, mesh, axis)` for the
     initial opt-state placement.
     """
-    import jax
-    from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     jmesh = getattr(mesh, "jax_mesh", mesh)
-    data_axes = tuple(a for a in data_axes if a in dict(jmesh.shape))
-    if not data_axes:
-        raise ValueError(
-            f"none of data_axes present in mesh axes {tuple(dict(jmesh.shape))}"
-        )
-    batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
-    rules = shd.fsdp_rules(axis)
+    constrain_dim0 = lambda tree: shd.constrain_dim0(tree, jmesh, axis)
 
-    def constrain_dim0(tree):
-        def one(leaf):
-            if not hasattr(leaf, "ndim") or leaf.ndim < 1:
-                return leaf
-            spec = shd.spec_for("zero2", tuple(leaf.shape), rules, jmesh)
-            return lax.with_sharding_constraint(
-                leaf, NamedSharding(jmesh, spec)
-            )
-
-        return jax.tree_util.tree_map(one, tree)
-
-    def step(params, opt_state, x, y, *rng):
-        def objective(p):
-            if has_rng:
-                fwd = lambda pp: apply_fn(pp, x, rngs={"dropout": rng[0]})
-            else:
-                fwd = lambda pp: apply_fn(pp, x)
-            if remat:
-                fwd = jax.checkpoint(fwd)
-            return loss_fn(fwd(p), y)
-
-        loss, grads = jax.value_and_grad(objective)(params)
-        grads = constrain_dim0(grads)  # -> reduce-scatter, not all-reduce
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        opt_state = constrain_dim0(opt_state)  # state stays 1/W per device
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return _make_constrained_train_step(
+        apply_fn,
+        loss_fn,
+        optimizer,
+        jmesh,
+        _batch_spec(jmesh, data_axes),
+        constrain_grads=constrain_dim0,  # -> reduce-scatter, not all-reduce
+        constrain_opt_state=constrain_dim0,  # state stays 1/W per device
         # replicated output -> one all-gather of the updates
-        params = shd.constrain(params, jmesh, shd.replicated_specs(params))
-        return params, opt_state, loss
-
-    rep = NamedSharding(jmesh, P())
-    xshard = NamedSharding(jmesh, batch_spec)
-    return jax.jit(
-        step,
-        in_shardings=(rep, None, xshard, xshard) + ((rep,) if has_rng else ()),
-        out_shardings=(rep, None, rep),
-        donate_argnums=(0, 1) if donate else (),
+        constrain_params=lambda p: shd.constrain(
+            p, jmesh, shd.replicated_specs(p)
+        ),
+        param_sharding=NamedSharding(jmesh, P()),
+        has_rng=has_rng,
+        remat=remat,
+        donate=donate,
     )
 
 

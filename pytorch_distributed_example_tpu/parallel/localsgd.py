@@ -83,6 +83,84 @@ class PeriodicModelAverager:
         return self._avg(stacked_params), True
 
 
+class HierarchicalModelAverager:
+    """torch `HierarchicalModelAverager` (`model_averaging/
+    hierarchical_model_averager.py`): a hierarchy of periods — small
+    contiguous groups average often, wider groups rarely. At each due
+    step the averager with the LARGEST period dividing the step wins
+    (torch picks the same way), and its group averaging runs as ONE
+    compiled `pmean` with `axis_index_groups` over the replica-stacked
+    params — contiguous rank groups of size g, the intra-node/inter-node
+    hierarchy shape.
+
+    `period_group_size_dict`: {period: group_size}, both strictly
+    increasing; the largest group size must equal the group's world size
+    (torch asserts this too).
+    """
+
+    def __init__(self, period_group_size_dict, warmup_steps: int = 0, group=None):
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from .. import distributed as dist
+
+        if not period_group_size_dict:
+            raise ValueError("period_group_size_dict must be non-empty")
+        items = sorted(period_group_size_dict.items())
+        periods = [p for p, _ in items]
+        sizes = [s for _, s in items]
+        if sizes != sorted(sizes) or len(set(sizes)) != len(sizes):
+            raise ValueError(
+                f"group sizes must strictly increase with period: {items}"
+            )
+        g = dist._resolve(group)
+        self.group = g
+        world = g.size() if callable(g.size) else g.size
+        if sizes[-1] != world:
+            raise ValueError(
+                f"largest group size {sizes[-1]} must equal world size {world}"
+            )
+        self.warmup_steps = warmup_steps
+        self.step = 0
+        self._periods = periods[::-1]  # largest first: first divisor wins
+        axis = g.mesh.axis_names[0]
+        mesh = g.mesh.jax_mesh
+
+        self._avg = {}
+        for period, size in items:
+            if world % size != 0:
+                raise ValueError(f"group size {size} does not divide {world}")
+            groups = [
+                list(range(i * size, (i + 1) * size))
+                for i in range(world // size)
+            ]
+            fn = shard_map_fn(
+                lambda p, _groups=groups: jax.tree_util.tree_map(
+                    lambda l: lax.pmean(l, axis, axis_index_groups=_groups), p
+                ),
+                mesh=mesh,
+                in_specs=P(axis),
+                out_specs=P(axis),
+            )
+            self._avg[period] = jax.jit(fn)
+        self._period_to_size = dict(items)
+
+    def average_parameters(self, stacked_params):
+        """Counts a step; averages at the widest due tier.
+        Returns (params, group_size_averaged_or_0)."""
+        self.step += 1
+        if self.step <= self.warmup_steps:
+            return stacked_params, 0
+        for period in self._periods:
+            if self.step % period == 0:
+                return (
+                    self._avg[period](stacked_params),
+                    self._period_to_size[period],
+                )
+        return stacked_params, 0
+
+
 def make_localsgd_train_step(
     apply_fn: Callable,
     loss_fn: Callable,

@@ -122,6 +122,26 @@ def constrain(tree, mesh, specs):
     )
 
 
+def constrain_dim0(tree, mesh, axis: str):
+    """Pin every array leaf dim-0 sharded over ``axis`` (inside jit) —
+    the ZeRO state/grad layout. Indivisible or scalar leaves stay as-is.
+    Shared by the ZeRO-2 train step and ZeroRedundancyOptimizer."""
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    rules = fsdp_rules(axis)
+
+    def one(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim < 1:
+            return leaf
+        spec = spec_for("zero", tuple(leaf.shape), rules, jmesh)
+        return lax.with_sharding_constraint(leaf, NamedSharding(jmesh, spec))
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def fsdp_rules(axis: str = "fsdp") -> Sequence[Rule]:
     """Catch-all rule used by `fsdp.fully_shard`: shard dim 0 of everything.
 

@@ -72,6 +72,25 @@ class TestPlacement:
         with pytest.raises(ValueError):
             distribute_tensor(_arr(4, (9, 2)), mesh, [Shard(0)])
 
+    def test_negative_shard_dim_canonicalized(self, mesh):
+        """torch accepts Shard(-1); it must actually shard the last dim,
+        not silently replicate."""
+        x = _arr(25, (4, 16))
+        dt = distribute_tensor(x, mesh, [Shard(-1)])
+        assert dt.placements == (Shard(1),)
+        assert {s.data.shape for s in dt.to_global().addressable_shards} == {
+            (4, 2)
+        }
+        with pytest.raises(ValueError):
+            distribute_tensor(x, mesh, [Shard(2)])  # out of range
+
+    def test_mixed_shard_partial_to_local_rejected(self, mesh2d):
+        gen = np.random.default_rng(26)
+        stack = np.asarray(gen.standard_normal((4, 2, 2, 3)), np.float32)
+        dt = DTensor.from_local(stack, mesh2d, [Shard(0), Partial()])
+        with pytest.raises(ValueError):
+            dt.to_local()
+
     def test_partial_rejected_from_full_tensor(self, mesh):
         with pytest.raises(ValueError):
             distribute_tensor(_arr(5, (8, 2)), mesh, [Partial()])
