@@ -26,7 +26,7 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-__all__ = ["dcp_save", "dcp_load", "DCPCheckpointer"]
+__all__ = ["dcp_save", "dcp_async_save", "dcp_load", "DCPCheckpointer"]
 
 
 def _checkpointer():
@@ -62,6 +62,57 @@ def dcp_save(state: Any, path: str, *, force: bool = True) -> str:
     return path
 
 
+class AsyncSaveHandle:
+    """Future-shaped handle for `dcp_async_save` (torch `async_save`
+    returns a Future). The handle OWNS the AsyncCheckpointer; a waiter
+    thread joins orbax's background write so `done()` flips on its own
+    and `result(timeout=...)` honors the Future contract (TimeoutError
+    on expiry, write keeps running)."""
+
+    def __init__(self, checkpointer, path: str):
+        import threading
+
+        self._ckptr = checkpointer
+        self.path = path
+        self._closed = False
+        self._waiter = threading.Thread(
+            target=checkpointer.wait_until_finished, daemon=True
+        )
+        self._waiter.start()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        """Block until the write is durable; returns the directory."""
+        self._waiter.join(timeout)
+        if self._waiter.is_alive():
+            raise TimeoutError(
+                f"checkpoint write to {self.path} still in flight after "
+                f"{timeout}s"
+            )
+        if not self._closed:
+            self._ckptr.close()
+            self._closed = True
+        return self.path
+
+    # Future-protocol aliases
+    wait = result
+
+    def done(self) -> bool:
+        return not self._waiter.is_alive()
+
+
+def dcp_async_save(state: Any, path: str, *, force: bool = True) -> AsyncSaveHandle:
+    """torch DCP `async_save`: snapshot device state, then persist in the
+    background — training resumes as soon as the device->host copy is
+    taken, not when bytes hit disk. Call `.result()` before relying on
+    (or overwriting) the checkpoint."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    ckptr.save(path, state, force=force)
+    return AsyncSaveHandle(ckptr, path)
+
+
 def dcp_load(template: Any, path: str) -> Any:
     """Restore into `template`'s structure AND shardings.
 
@@ -88,12 +139,19 @@ class DCPCheckpointer:
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, state: Any) -> bool:
+    def save(self, step: int, state: Any, wait: bool = True) -> bool:
+        """`wait=False` returns after the device->host snapshot and lets
+        the write land in the background (join with `wait_until_finished`
+        or the next save/close)."""
         import orbax.checkpoint as ocp
 
         ok = self._mgr.save(step, args=ocp.args.PyTreeSave(state))
-        self._mgr.wait_until_finished()
+        if wait:
+            self._mgr.wait_until_finished()
         return ok
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
 
     def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
         import orbax.checkpoint as ocp

@@ -1,15 +1,21 @@
-"""Minimal batched DataLoader over an index sampler.
+"""Batched DataLoader over an index sampler, with background prefetch.
 
 Plays the role torch's DataLoader plays in the reference's training loop
 (SURVEY.md §3.3): iterate sampler indices, gather into contiguous numpy
-batches. Device transfer happens once per step in the train loop
-(`jax.device_put` of the global batch with the dp sharding), which keeps
-host→HBM traffic to exactly one copy per step.
+batches. `num_workers > 0` overlaps batch ASSEMBLY with the train step
+the way torch's worker processes do — a thread pool fetches upcoming
+batches while the accelerator runs, `prefetch_factor` bounding how far
+ahead it reads (threads, not processes: the fetch work is numpy gather
+and IO, which release the GIL, and the heavy compute lives on the
+device). Order is always the sampler's order. Device transfer still
+happens once per step in the train loop (`jax.device_put` of the global
+batch with the dp sharding), keeping host→HBM traffic to exactly one
+copy per step.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, Optional, Sequence, Tuple
+from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -23,31 +29,73 @@ class DataLoader:
         drop_last: bool = False,
         shuffle: bool = False,
         seed: int = 0,
+        num_workers: int = 0,
+        prefetch_factor: int = 2,
+        collate_fn: Optional[Callable] = None,
     ):
+        if num_workers < 0 or prefetch_factor < 1:
+            raise ValueError("num_workers >= 0 and prefetch_factor >= 1")
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler
         self.drop_last = drop_last
         self.shuffle = shuffle
         self.seed = seed
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.collate_fn = collate_fn
         self._epoch = 0
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _indices(self):
         if self.sampler is not None:
-            indices = list(iter(self.sampler))
-        elif self.shuffle:
+            return list(iter(self.sampler))
+        if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
-            indices = rng.permutation(len(self.dataset)).tolist()
             self._epoch += 1
-        else:
-            indices = list(range(len(self.dataset)))
+            return rng.permutation(len(self.dataset)).tolist()
+        return list(range(len(self.dataset)))
+
+    def _batches(self, indices):
         for start in range(0, len(indices), self.batch_size):
             batch_idx = indices[start : start + self.batch_size]
             if self.drop_last and len(batch_idx) < self.batch_size:
-                break
-            idx = np.asarray(batch_idx)
-            x, y = self.dataset[idx]
-            yield x, y
+                return
+            yield np.asarray(batch_idx)
+
+    def _fetch(self, idx):
+        out = self.dataset[idx]
+        return self.collate_fn(out) if self.collate_fn is not None else out
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        indices = self._indices()
+        if self.num_workers == 0:
+            for idx in self._batches(indices):
+                yield self._fetch(idx)
+            return
+        yield from self._iter_prefetch(indices)
+
+    def _iter_prefetch(self, indices):
+        """Fetch up to num_workers batches concurrently, keeping at most
+        num_workers * prefetch_factor in flight, delivering in order."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        depth = self.num_workers * self.prefetch_factor
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = deque()
+            batch_iter = self._batches(indices)
+            try:
+                for idx in batch_iter:
+                    pending.append(pool.submit(self._fetch, idx))
+                    # only drain past the depth so a full `depth` batches
+                    # stay in flight WHILE the consumer runs its step
+                    if len(pending) > depth:
+                        yield pending.popleft().result()
+                while pending:
+                    yield pending.popleft().result()
+            finally:
+                for f in pending:  # consumer bailed early / fetch raised
+                    f.cancel()
 
     def __len__(self) -> int:
         n = len(self.sampler) if self.sampler is not None else len(self.dataset)

@@ -70,6 +70,80 @@ class TestSamplerSemantics:
             DistributedSampler(_Sized(10), num_replicas=2, rank=2)
 
 
+class TestDataLoaderPrefetch:
+    """num_workers>0: same batches in the same order as the sequential
+    path; exceptions propagate; early break doesn't wedge the pool."""
+
+    class _DS:
+        def __init__(self, n=64, fail_at=None):
+            self.x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+            self.y = np.arange(n, dtype=np.int64)
+            self.fail_at = fail_at
+
+        def __len__(self):
+            return len(self.y)
+
+        def __getitem__(self, idx):
+            if self.fail_at is not None and self.fail_at in np.atleast_1d(idx):
+                raise RuntimeError("boom")
+            return self.x[idx], self.y[idx]
+
+    def test_prefetch_matches_sequential(self):
+        from pytorch_distributed_example_tpu.data.loader import DataLoader
+
+        ds = self._DS(64)
+        seq = list(DataLoader(ds, batch_size=10))
+        pre = list(DataLoader(ds, batch_size=10, num_workers=3))
+        assert len(seq) == len(pre) == 7
+        for (xa, ya), (xb, yb) in zip(seq, pre):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
+    def test_prefetch_with_sampler_and_drop_last(self):
+        from pytorch_distributed_example_tpu.data.loader import DataLoader
+        from pytorch_distributed_example_tpu.data.sampler import (
+            DistributedSampler,
+        )
+
+        ds = self._DS(64)
+        s = DistributedSampler(ds, num_replicas=4, rank=1, shuffle=True, seed=3)
+        seq = list(DataLoader(ds, 6, sampler=s, drop_last=True))
+        s2 = DistributedSampler(ds, num_replicas=4, rank=1, shuffle=True, seed=3)
+        pre = list(
+            DataLoader(ds, 6, sampler=s2, drop_last=True, num_workers=2)
+        )
+        assert len(seq) == len(pre) == 2  # 16 per rank // 6
+        for (xa, _), (xb, _) in zip(seq, pre):
+            np.testing.assert_array_equal(xa, xb)
+
+    def test_collate_fn_applies(self):
+        from pytorch_distributed_example_tpu.data.loader import DataLoader
+
+        ds = self._DS(20)
+        ld = DataLoader(
+            ds, 5, num_workers=2, collate_fn=lambda b: (b[0] * 2, b[1])
+        )
+        x, _ = next(iter(ld))
+        np.testing.assert_array_equal(x, ds.x[:5] * 2)
+
+    def test_fetch_exception_propagates(self):
+        import pytest as _pytest
+
+        from pytorch_distributed_example_tpu.data.loader import DataLoader
+
+        ds = self._DS(32, fail_at=17)
+        with _pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(ds, 8, num_workers=2))
+
+    def test_early_break_does_not_hang(self):
+        from pytorch_distributed_example_tpu.data.loader import DataLoader
+
+        ds = self._DS(64)
+        it = iter(DataLoader(ds, 4, num_workers=4, prefetch_factor=2))
+        next(it)
+        it.close()  # generator close must shut the pool down cleanly
+
+
 class TestTorchOracle:
     """Structural equivalence with torch.utils.data.DistributedSampler."""
 
