@@ -77,6 +77,7 @@ from .parallel.reducer import Reducer  # noqa: F401
 from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
 from . import nn  # noqa: F401  (differentiable collectives: tdx.nn.functional)
 from . import optim  # noqa: F401  (ZeroRedundancyOptimizer, PostLocalSGDOptimizer)
+from . import amp  # noqa: F401  (GradScaler, dtype policies)
 from .dtensor import (  # noqa: F401
     DTensor,
     Partial,

@@ -1,0 +1,179 @@
+"""Mixed precision — `torch.amp` parity, TPU-native.
+
+Torch's AMP pairs an autocast context (op-level dtype policy) with a
+`GradScaler` (dynamic loss scaling for fp16's narrow exponent range).
+The TPU-native translation:
+
+* **Policy** — XLA has no autocast dispatcher; precision is a POLICY
+  applied to trees at the jit boundary (the jmp convention, and what
+  `TransformerConfig(dtype=...)` does model-side): params kept in
+  `param_dtype`, cast to `compute_dtype` for the forward, outputs to
+  `output_dtype`. bf16 is the TPU default compute type and needs NO loss
+  scaling (same exponent range as fp32) — `GradScaler` matters for fp16
+  interop and parity.
+* **GradScaler** — functional, jit-compatible: state is a small pytree
+  (scale, growth counter) threaded through the step; `scale` multiplies
+  the loss, `unscale` divides grads and reports finiteness, `update`
+  applies torch's growth/backoff schedule (`torch/amp/grad_scaler.py`:
+  growth_factor 2.0, backoff_factor 0.5, growth_interval 2000) with
+  `jnp.where` instead of host branches, and `masked_update` skips the
+  optimizer step on overflow exactly like `GradScaler.step`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Policy:
+    """Dtype policy (jmp-shaped): where params live, where math runs."""
+
+    param_dtype: Any = None  # None = leave as-is
+    compute_dtype: Any = None
+    output_dtype: Any = None
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return tree
+
+    def one(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def get_policy(name: str) -> Policy:
+    """'bf16' / 'f32' / 'fp16' shorthand (jmp's `get_policy` shape)."""
+    import jax.numpy as jnp
+
+    table = {
+        "bf16": Policy(jnp.float32, jnp.bfloat16, jnp.float32),
+        "fp16": Policy(jnp.float32, jnp.float16, jnp.float32),
+        "f32": Policy(jnp.float32, jnp.float32, jnp.float32),
+    }
+    if name not in table:
+        raise ValueError(f"unknown policy {name!r}; one of {sorted(table)}")
+    return table[name]
+
+
+class ScalerState(NamedTuple):
+    scale: Any  # f32 scalar
+    growth_tracker: Any  # i32 scalar: consecutive finite steps
+
+
+class GradScaler:
+    """Functional dynamic loss scaler (torch `torch/amp/grad_scaler.py`).
+
+    Usage inside a jit step (note BOTH params and optimizer state must be
+    gated on `finite` — torch's `GradScaler.step` skips `optimizer.step()`
+    entirely on overflow, so the poisoned grads must not leak into
+    stateful optimizers like Adam)::
+
+        state = scaler.init()
+        scaled_loss = scaler.scale(loss, state)
+        grads = jax.grad(...)                       # of the SCALED loss
+        grads, finite = scaler.unscale(grads, state)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        params = scaler.where_finite(finite, new_params, params)
+        opt_state = scaler.where_finite(finite, new_opt, opt_state)
+        state = scaler.update(state, finite)
+    """
+
+    def __init__(
+        self,
+        init_scale: float = 2.0**16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+    ):
+        if growth_factor <= 1.0 or not (0.0 < backoff_factor < 1.0):
+            raise ValueError("growth_factor > 1 and 0 < backoff_factor < 1")
+        self.init_scale = init_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+
+    def init(self) -> ScalerState:
+        import jax.numpy as jnp
+
+        return ScalerState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+        )
+
+    def scale(self, loss, state: ScalerState):
+        # fp16 * f32 promotes to f32 — do NOT cast the scale into the
+        # loss dtype: the torch-default 2**16 rounds to inf in fp16 and
+        # every step would spuriously overflow
+        return loss * state.scale
+
+    def unscale(self, grads, state: ScalerState) -> Tuple[Any, Any]:
+        """Divide grads by the scale; returns (grads_f32, all_finite)."""
+        import jax
+        import jax.numpy as jnp
+
+        inv = 1.0 / state.scale
+
+        def one(g):
+            return g.astype(jnp.float32) * inv
+
+        grads = jax.tree_util.tree_map(one, grads)
+        finite = jnp.asarray(True)
+        for leaf in jax.tree_util.tree_leaves(grads):
+            finite = jnp.logical_and(finite, jnp.isfinite(leaf).all())
+        return grads, finite
+
+    def update(self, state: ScalerState, finite) -> ScalerState:
+        """torch's schedule: overflow -> scale *= backoff, tracker reset;
+        `growth_interval` consecutive finite steps -> scale *= growth."""
+        import jax.numpy as jnp
+
+        tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        scale = jnp.where(
+            finite,
+            jnp.where(grow, state.scale * self.growth_factor, state.scale),
+            state.scale * self.backoff_factor,
+        )
+        tracker = jnp.where(grow, 0, tracker)
+        return ScalerState(scale=scale, growth_tracker=tracker)
+
+    def where_finite(self, finite, new_tree, old_tree):
+        """Select `new_tree` where grads were finite, else keep
+        `old_tree` — gate BOTH params and optimizer state through this
+        (`GradScaler.step`'s skip-on-overflow covers the optimizer's
+        state mutation too)."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(finite, n, o), new_tree, old_tree
+        )
+
+    def masked_update(self, finite, params, updates):
+        """Convenience: params + updates gated on finiteness. Remember to
+        gate the optimizer state with `where_finite` as well."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda p, u: jnp.where(finite, p + u, p), params, updates
+        )

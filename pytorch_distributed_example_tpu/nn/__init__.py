@@ -1,1 +1,2 @@
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401

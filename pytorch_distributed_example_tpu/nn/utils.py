@@ -1,0 +1,62 @@
+"""Gradient utilities — `torch.nn.utils` parity.
+
+`clip_grad_norm_` / `clip_grad_value_` over grad PYTREES. Under GSPMD
+the leaves are global jax.Arrays, so the norms here are already GLOBAL
+norms regardless of how the grads are sharded — the distributed-aware
+behavior torch gets from `DTensor`-aware clip or FSDP's
+`clip_grad_norm_` falls out for free. Inside a `shard_map` region pass
+`axis_name` to psum the squared norms across ranks first (the manual
+equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+
+def clip_grad_norm_(
+    grads,
+    max_norm: float,
+    norm_type: float = 2.0,
+    axis_name: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Scale `grads` so the total norm is at most `max_norm`.
+
+    Returns (clipped_grads, total_norm) — torch returns the pre-clip
+    total norm; so does this. `norm_type` supports any p >= 1 and inf.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return grads, jnp.asarray(0.0)
+
+    if norm_type == float("inf"):
+        per = [jnp.max(jnp.abs(l)) for l in leaves]
+        total = jnp.max(jnp.stack([p.astype(jnp.float32) for p in per]))
+        if axis_name is not None:
+            total = lax.pmax(total, axis_name)
+    else:
+        acc = sum(
+            jnp.sum(jnp.abs(l).astype(jnp.float32) ** norm_type) for l in leaves
+        )
+        if axis_name is not None:
+            acc = lax.psum(acc, axis_name)
+        total = acc ** (1.0 / norm_type)
+
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda l: (l * scale).astype(l.dtype), grads
+    )
+    return clipped, total
+
+
+def clip_grad_value_(grads, clip_value: float):
+    """Clamp every gradient element into [-clip_value, clip_value]."""
+    import jax
+    import jax.numpy as jnp
+
+    v = abs(clip_value)
+    return jax.tree_util.tree_map(lambda l: jnp.clip(l, -v, v), grads)

@@ -1,0 +1,232 @@
+"""Mixed-precision tests (`torch.amp` parity, `amp.py` + `nn/utils.py`):
+GradScaler growth/backoff schedule, overflow-skip semantics, an fp16
+end-to-end training loop that recovers from overflow, dtype policies,
+and global grad clipping."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu.amp import (
+    GradScaler,
+    Policy,
+    get_policy,
+)
+from pytorch_distributed_example_tpu.nn.utils import (
+    clip_grad_norm_,
+    clip_grad_value_,
+)
+
+
+class TestGradScaler:
+    def test_scale_unscale_round_trip(self):
+        import jax.numpy as jnp
+
+        s = GradScaler(init_scale=1024.0)
+        st = s.init()
+        loss = jnp.asarray(2.0, jnp.float16)
+        scaled = s.scale(loss, st)
+        assert float(scaled) == 2048.0
+        assert scaled.dtype == jnp.float32  # promoted, not cast down
+        grads = {"w": jnp.asarray([1024.0, 2048.0], jnp.float16)}
+        un, finite = s.unscale(grads, st)
+        np.testing.assert_allclose(np.asarray(un["w"]), [1.0, 2.0])
+        assert un["w"].dtype == jnp.float32
+        assert bool(finite)
+
+    def test_default_scale_survives_fp16_loss(self):
+        """torch's default 2**16 exceeds fp16 max (65504): the scaled loss
+        must promote to f32, not round the scale to inf."""
+        import jax.numpy as jnp
+
+        s = GradScaler()  # init_scale = 2**16
+        st = s.init()
+        scaled = s.scale(jnp.asarray(1.5, jnp.float16), st)
+        assert np.isfinite(float(scaled))
+        assert float(scaled) == 1.5 * 2.0**16
+
+    def test_overflow_detected_and_backoff(self):
+        import jax.numpy as jnp
+
+        s = GradScaler(init_scale=1024.0, backoff_factor=0.5)
+        st = s.init()
+        grads = {"w": jnp.asarray([jnp.inf, 1.0], jnp.float32)}
+        _, finite = s.unscale(grads, st)
+        assert not bool(finite)
+        st2 = s.update(st, finite)
+        assert float(st2.scale) == 512.0
+        assert int(st2.growth_tracker) == 0
+
+    def test_growth_after_interval(self):
+        import jax.numpy as jnp
+
+        s = GradScaler(init_scale=8.0, growth_factor=2.0, growth_interval=3)
+        st = s.init()
+        finite = jnp.asarray(True)
+        for _ in range(2):
+            st = s.update(st, finite)
+            assert float(st.scale) == 8.0
+        st = s.update(st, finite)  # 3rd consecutive finite step
+        assert float(st.scale) == 16.0
+        assert int(st.growth_tracker) == 0
+
+    def test_masked_update_skips_on_overflow(self):
+        import jax.numpy as jnp
+
+        s = GradScaler()
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        updates = {"w": jnp.asarray([-0.5, -0.5])}
+        kept = s.masked_update(jnp.asarray(False), params, updates)
+        np.testing.assert_array_equal(np.asarray(kept["w"]), [1.0, 2.0])
+        applied = s.masked_update(jnp.asarray(True), params, updates)
+        np.testing.assert_array_equal(np.asarray(applied["w"]), [0.5, 1.5])
+
+    def test_fp16_training_recovers_from_overflow(self):
+        """End-to-end with a STATEFUL optimizer (adam): a poisoned first
+        batch is skipped — params AND moments untouched (inf grads must
+        not poison adam's second moment) — the scaler backs off, and
+        training proceeds."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        scaler = GradScaler(init_scale=2.0**10)
+        opt = optax.adam(0.05)
+        w0 = jnp.asarray([1.0, 1.0], jnp.float32)
+
+        @jax.jit
+        def step(w, opt_state, sstate, x, y):
+            def lf(w):
+                pred = (x.astype(jnp.float16) @ w.astype(jnp.float16)).astype(
+                    jnp.float32
+                )
+                loss = ((pred - y) ** 2).mean()
+                return scaler.scale(loss, sstate)
+
+            grads = jax.grad(lf)(w)
+            grads, finite = scaler.unscale(grads, sstate)
+            updates, new_opt_state = opt.update(grads, opt_state, w)
+            new_w = optax.apply_updates(w, updates)
+            w = scaler.where_finite(finite, new_w, w)
+            opt_state = scaler.where_finite(finite, new_opt_state, opt_state)
+            return w, opt_state, scaler.update(sstate, finite), finite
+
+        sstate = scaler.init()
+        opt_state = opt.init(w0)
+        gen = np.random.default_rng(0)
+
+        # poisoned batch: fp16 overflow in the forward
+        x_bad = jnp.asarray(np.full((4, 2), 60000.0), jnp.float32)
+        y = jnp.zeros((4,), jnp.float32)
+        w, opt_state, sstate, finite = step(w0, opt_state, sstate, x_bad, y)
+        assert not bool(finite)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(w0))  # skipped
+        assert float(sstate.scale) == 2.0**9  # backed off
+        # adam's moments must be untouched by the inf grads
+        for leaf in jax.tree_util.tree_leaves(opt_state):
+            assert np.isfinite(np.asarray(leaf, dtype=np.float64)).all()
+
+        x = jnp.asarray(gen.standard_normal((4, 2)), jnp.float32)
+        losses = []
+        for _ in range(10):
+            w, opt_state, sstate, finite = step(w, opt_state, sstate, x, y)
+            assert bool(finite)
+            losses.append(float(((x @ w) ** 2).mean()))
+        assert losses[-1] < losses[0]
+
+    def test_bad_hyperparams_rejected(self):
+        with pytest.raises(ValueError):
+            GradScaler(growth_factor=1.0)
+        with pytest.raises(ValueError):
+            GradScaler(backoff_factor=1.5)
+
+
+class TestPolicy:
+    def test_policy_casts_only_floats(self):
+        import jax.numpy as jnp
+
+        pol = get_policy("bf16")
+        tree = {
+            "w": jnp.ones((2,), jnp.float32),
+            "step": jnp.asarray(3, jnp.int32),
+        }
+        cast = pol.cast_to_compute(tree)
+        assert cast["w"].dtype == jnp.bfloat16
+        assert cast["step"].dtype == jnp.int32
+        back = pol.cast_to_param(cast)
+        assert back["w"].dtype == jnp.float32
+
+    def test_unknown_policy(self):
+        with pytest.raises(ValueError):
+            get_policy("tf32")
+
+
+class TestClipGrad:
+    def test_clip_norm_matches_torch_semantics(self):
+        import jax.numpy as jnp
+
+        grads = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([12.0])}
+        clipped, total = clip_grad_norm_(grads, max_norm=6.5)
+        assert float(total) == pytest.approx(13.0)  # sqrt(9+16+144)
+        # clipped to max_norm: norm of result == 6.5 (up to the eps)
+        got = np.sqrt(
+            sum(
+                float((np.asarray(l) ** 2).sum())
+                for l in [clipped["a"], clipped["b"]]
+            )
+        )
+        assert got == pytest.approx(6.5, rel=1e-4)
+
+    def test_no_clip_below_threshold(self):
+        import jax.numpy as jnp
+
+        grads = {"a": jnp.asarray([0.3, 0.4])}
+        clipped, total = clip_grad_norm_(grads, max_norm=10.0)
+        assert float(total) == pytest.approx(0.5)
+        np.testing.assert_allclose(
+            np.asarray(clipped["a"]), [0.3, 0.4], rtol=1e-5
+        )
+
+    def test_inf_norm(self):
+        import jax.numpy as jnp
+
+        grads = {"a": jnp.asarray([-7.0, 2.0]), "b": jnp.asarray([3.0])}
+        clipped, total = clip_grad_norm_(grads, 3.5, norm_type=float("inf"))
+        assert float(total) == 7.0
+        assert float(np.abs(np.asarray(clipped["a"])).max()) == pytest.approx(
+            3.5, rel=1e-4
+        )
+
+    def test_clip_value(self):
+        import jax.numpy as jnp
+
+        grads = {"a": jnp.asarray([-7.0, 0.2])}
+        out = clip_grad_value_(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(out["a"]), [-1.0, 0.2], rtol=1e-6)
+
+    def test_global_norm_under_shard_map(self):
+        """axis_name form: per-rank shards psum to the same global norm."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+
+        mesh = init_device_mesh(("dp",), (8,))
+        g = jnp.arange(16.0).reshape(16, 1)
+
+        def f(gl):
+            clipped, total = clip_grad_norm_(
+                {"g": gl}, max_norm=1.0, axis_name="dp"
+            )
+            return clipped["g"], total[None]
+
+        mapped = shard_map_fn(
+            f, mesh=mesh.jax_mesh, in_specs=(P("dp"),), out_specs=(P("dp"), P("dp"))
+        )
+        clipped, totals = jax.jit(mapped)(g)
+        want = float(np.linalg.norm(np.arange(16.0)))
+        np.testing.assert_allclose(np.asarray(totals).ravel(), want, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.linalg.norm(np.asarray(clipped).ravel())), 1.0, rtol=1e-3
+        )
