@@ -16,8 +16,9 @@ The TPU-native translation:
   the loss, `unscale` divides grads and reports finiteness, `update`
   applies torch's growth/backoff schedule (`torch/amp/grad_scaler.py`:
   growth_factor 2.0, backoff_factor 0.5, growth_interval 2000) with
-  `jnp.where` instead of host branches, and `masked_update` skips the
-  optimizer step on overflow exactly like `GradScaler.step`.
+  `jnp.where` instead of host branches, and `where_finite` skips the
+  optimizer step (params AND state) on overflow exactly like
+  `GradScaler.step`.
 """
 
 from __future__ import annotations
@@ -125,10 +126,19 @@ class GradScaler:
         # every step would spuriously overflow
         return loss * state.scale
 
-    def unscale(self, grads, state: ScalerState) -> Tuple[Any, Any]:
-        """Divide grads by the scale; returns (grads_f32, all_finite)."""
+    def unscale(
+        self, grads, state: ScalerState, axis_name: Optional[str] = None
+    ) -> Tuple[Any, Any]:
+        """Divide grads by the scale; returns (grads_f32, all_finite).
+
+        With per-rank-sharded grads (shard_map / ZeRO layouts) pass
+        `axis_name`: finiteness is then agreed ACROSS ranks (torch's
+        ShardedGradScaler all-reduces found_inf for the same reason) —
+        otherwise one rank can skip the step while another applies it and
+        replicated state diverges permanently."""
         import jax
         import jax.numpy as jnp
+        from jax import lax
 
         inv = 1.0 / state.scale
 
@@ -139,6 +149,8 @@ class GradScaler:
         finite = jnp.asarray(True)
         for leaf in jax.tree_util.tree_leaves(grads):
             finite = jnp.logical_and(finite, jnp.isfinite(leaf).all())
+        if axis_name is not None:
+            finite = lax.pmin(finite.astype(jnp.int32), axis_name) == 1
         return grads, finite
 
     def update(self, state: ScalerState, finite) -> ScalerState:
@@ -168,12 +180,3 @@ class GradScaler:
             lambda n, o: jnp.where(finite, n, o), new_tree, old_tree
         )
 
-    def masked_update(self, finite, params, updates):
-        """Convenience: params + updates gated on finiteness. Remember to
-        gate the optimizer state with `where_finite` as well."""
-        import jax
-        import jax.numpy as jnp
-
-        return jax.tree_util.tree_map(
-            lambda p, u: jnp.where(finite, p + u, p), params, updates
-        )

@@ -1,0 +1,64 @@
+"""Activation checkpointing — torch `checkpoint_wrapper` parity.
+
+Torch wraps modules (`torch/distributed/algorithms/_checkpoint/
+checkpoint_wrapper.py`) so their activations are recomputed in backward.
+The TPU-native mechanism is `jax.checkpoint` (remat) with a POLICY
+choosing what to save — richer than torch's binary wrap/no-wrap because
+XLA can keep the cheap-to-store, expensive-to-recompute values (e.g.
+matmul results) and recompute the rest. This module names the common
+policies and keeps the torch-shaped entry point. The model-level seam is
+`TransformerConfig(remat=True)` / the train-step `remat=` flags; this
+wrapper is the functional form for arbitrary fns.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+_POLICIES = {
+    # recompute everything (torch checkpoint_wrapper semantics)
+    "nothing": "nothing_saveable",
+    # save matmul/einsum outputs, recompute elementwise — the usual best
+    # FLOPs/HBM trade on TPU
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    # save everything = no remat (identity wrap, for A/B comparisons)
+    "everything": "everything_saveable",
+}
+
+
+def checkpoint_wrapper(
+    fn: Callable,
+    policy: str = "nothing",
+    prevent_cse: bool = True,
+    static_argnums=(),
+) -> Callable:
+    """torch `checkpoint_wrapper(module)` for functions: returns `fn`
+    rematerialized under the named save policy (see `_POLICIES`)."""
+    import jax
+
+    if policy not in _POLICIES:
+        raise ValueError(
+            f"unknown checkpoint policy {policy!r}; one of {sorted(_POLICIES)}"
+        )
+    pol = getattr(jax.checkpoint_policies, _POLICIES[policy])
+    return jax.checkpoint(
+        fn, policy=pol, prevent_cse=prevent_cse, static_argnums=static_argnums
+    )
+
+
+def apply_activation_checkpointing(
+    apply_fn: Callable, check_fn: Optional[Callable[[str], bool]] = None
+) -> Callable:
+    """torch `apply_activation_checkpointing(model, check_fn=...)` shape:
+    wrap a flax `apply` so the whole forward is rematerialized. Per-layer
+    selection belongs model-side (`TransformerConfig(remat=True)` remats
+    each Block); `check_fn` is accepted for API parity and must be None
+    here — selective wrapping of arbitrary submodules has no functional
+    analog at this seam."""
+    if check_fn is not None:
+        raise NotImplementedError(
+            "per-submodule selection: use the model's remat config "
+            "(e.g. TransformerConfig(remat=True)) instead"
+        )
+    return checkpoint_wrapper(apply_fn)

@@ -69,16 +69,42 @@ class TestGradScaler:
         assert float(st.scale) == 16.0
         assert int(st.growth_tracker) == 0
 
-    def test_masked_update_skips_on_overflow(self):
+    def test_where_finite_skips_on_overflow(self):
         import jax.numpy as jnp
 
         s = GradScaler()
-        params = {"w": jnp.asarray([1.0, 2.0])}
-        updates = {"w": jnp.asarray([-0.5, -0.5])}
-        kept = s.masked_update(jnp.asarray(False), params, updates)
+        old = {"w": jnp.asarray([1.0, 2.0])}
+        new = {"w": jnp.asarray([0.5, 1.5])}
+        kept = s.where_finite(jnp.asarray(False), new, old)
         np.testing.assert_array_equal(np.asarray(kept["w"]), [1.0, 2.0])
-        applied = s.masked_update(jnp.asarray(True), params, updates)
+        applied = s.where_finite(jnp.asarray(True), new, old)
         np.testing.assert_array_equal(np.asarray(applied["w"]), [0.5, 1.5])
+
+    def test_unscale_axis_name_agrees_across_ranks(self):
+        """Sharded grads where ONE rank overflows: every rank must see
+        finite=False (torch ShardedGradScaler's found_inf all-reduce)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+
+        mesh = init_device_mesh(("dp",), (8,))
+        s = GradScaler(init_scale=2.0)
+        st = s.init()
+        g = np.ones((8, 3), np.float32)
+        g[5, 1] = np.inf  # only rank 5's shard overflows
+
+        def f(gl):
+            _, finite = s.unscale({"g": gl}, st, axis_name="dp")
+            return finite.astype(jnp.int32)[None]
+
+        mapped = shard_map_fn(
+            f, mesh=mesh.jax_mesh, in_specs=(P("dp"),), out_specs=P("dp")
+        )
+        per_rank = np.asarray(jax.jit(mapped)(jnp.asarray(g)))
+        assert (per_rank == 0).all()  # unanimous overflow verdict
 
     def test_fp16_training_recovers_from_overflow(self):
         """End-to-end with a STATEFUL optimizer (adam): a poisoned first

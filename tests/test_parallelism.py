@@ -384,6 +384,38 @@ class TestPipeline:
             np.asarray(g1["w"]), np.asarray(g2["w"]), rtol=1e-4, atol=1e-6
         )
 
+    def test_1f1b_activation_memory_constant_in_microbatches(self):
+        """THE 1F1B property: XLA temp memory is flat in M (bounded
+        residual ring) while GPipe-through-grad grows with M (all
+        microbatch residuals live until the backward)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.parallel import make_pipeline_train_fn
+
+        mesh = init_device_mesh(("pp",), (8,))
+        S, mb, F = 8, 4, 64
+        gen = np.random.default_rng(11)
+        ws = [jnp.asarray(gen.standard_normal((F, F)) * 0.1, jnp.float32) for _ in range(S)]
+        stacked = stack_stage_params([{"w": w} for w in ws])
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def loss_fn(y, t):
+            return ((y - t) ** 2).mean()
+
+        def temp_bytes(sched, M):
+            x = jnp.zeros((M, mb, F))
+            f = make_pipeline_train_fn(stage_fn, loss_fn, mesh, schedule=sched)
+            ma = f.lower(stacked, x, x).compile().memory_analysis()
+            if ma is None:
+                pytest.skip("backend exposes no memory analysis")
+            return ma.temp_size_in_bytes
+
+        assert temp_bytes("1f1b", 32) == temp_bytes("1f1b", 8)
+        assert temp_bytes("gpipe", 32) > temp_bytes("gpipe", 8)
+
     def test_interleaved_matches_sequential(self):
         """virtual_stages=V: 2 ring rounds over 4 devices == 8 serial stages."""
         import jax
