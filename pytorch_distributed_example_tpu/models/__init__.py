@@ -1,3 +1,9 @@
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertEncoder,
+    BertForSequenceClassification,
+    bert_sharding_rules,
+)
 from .convnet import ConvNet  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50  # noqa: F401
 from .transformer import (  # noqa: F401

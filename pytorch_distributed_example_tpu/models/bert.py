@@ -1,0 +1,182 @@
+"""BERT-style bidirectional encoder — BASELINE config #4's model family.
+
+The reference stack's BERT-base fine-tune workload (BASELINE.md config
+#4) wants a REAL encoder, not a causal LM at BERT scale: bidirectional
+attention, learned absolute position + token-type embeddings, post-LN
+blocks with GELU MLPs, a tanh [CLS] pooler, and task heads. Classic
+BERT-base geometry is 12L/768d/12H/3072ff.
+
+TPU notes: attention runs the same batched MXU einsums as
+`models/transformer.py` (no causal mask); everything is static-shape
+jit-friendly; `bert_sharding_rules` gives the canonical 2-D (fsdp x tp)
+GSPMD layout matching `transformer.sharding_rules`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    norm_eps: float = 1e-12  # BERT's LayerNorm epsilon
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        B, L, D = x.shape
+        H, Dh = cfg.n_heads, cfg.head_dim
+        dense = lambda name: nn.Dense(D, dtype=cfg.dtype, name=name)
+        q = dense("query")(x).reshape(B, L, H, Dh)
+        k = dense("key")(x).reshape(B, L, H, Dh)
+        v = dense("value")(x).reshape(B, L, H, Dh)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(Dh).astype(
+            cfg.dtype
+        )
+        if mask is not None:
+            # mask: (B, L) 1=attend 0=pad -> additive bias on keys
+            s = s + jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30).astype(
+                s.dtype
+            )
+        p = nn.softmax(s, axis=-1)
+        p = nn.Dropout(cfg.dropout)(p, deterministic=deterministic)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, L, D)
+        return dense("output")(o)
+
+
+class BertBlock(nn.Module):
+    """Post-LN transformer block (original BERT ordering)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name
+        )
+        h = BertSelfAttention(cfg, name="attn")(x, mask, deterministic)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = ln("ln_attn")(x + h)
+        m = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_up")(x)
+        m = nn.gelu(m)
+        m = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_down")(m)
+        m = nn.Dropout(cfg.dropout)(m, deterministic=deterministic)
+        return ln("ln_mlp")(x + m)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + N bidirectional blocks + [CLS] pooler."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        deterministic: bool = True,
+        train: Optional[bool] = None,
+    ):
+        if train is not None:  # repo-wide `train=` convention (ConvNet/DDP)
+            deterministic = not train
+        cfg = self.cfg
+        B, L = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_emb")(
+            input_ids
+        )
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model),
+        )[:L]
+        ttype = nn.Embed(
+            cfg.type_vocab_size, cfg.d_model, dtype=cfg.dtype, name="type_emb"
+        )(
+            token_type_ids
+            if token_type_ids is not None
+            else jnp.zeros_like(input_ids)
+        )
+        x = tok + pos[None].astype(cfg.dtype) + ttype
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ln_emb")(x)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        for i in range(cfg.n_layers):
+            x = BertBlock(cfg, name=f"layer_{i}")(
+                x, attention_mask, deterministic
+            )
+
+        pooled = nn.tanh(
+            nn.Dense(cfg.d_model, dtype=cfg.dtype, name="pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    """The fine-tune head config #4 exercises: pooled [CLS] -> logits."""
+
+    cfg: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        deterministic: bool = True,
+        train: Optional[bool] = None,
+    ):
+        if train is not None:
+            deterministic = not train
+        _, pooled = BertEncoder(self.cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic
+        )
+        pooled = nn.Dropout(self.cfg.dropout)(
+            pooled, deterministic=deterministic
+        )
+        return nn.Dense(self.num_labels, dtype=self.cfg.dtype, name="classifier")(
+            pooled
+        )
+
+
+def bert_sharding_rules(tp_axis: Optional[str] = "tp", fsdp_axis=None):
+    """Canonical 2-D GSPMD layout (matching `transformer.sharding_rules`):
+    kernels split over BOTH axes — tp on the Megatron dim (column for
+    QKV/up, row for out/down), fsdp on the other — embeddings over the
+    vocab dim, everything else dim-0 over fsdp when given."""
+    f = fsdp_axis
+    rules = []
+    if tp_axis:
+        rules += [
+            (r"attn/(query|key|value)/kernel", (f, tp_axis)),
+            (r"attn/output/kernel", (tp_axis, f)),
+            (r"mlp_up/kernel", (f, tp_axis)),
+            (r"mlp_down/kernel", (tp_axis, f)),
+            (r"tok_emb/embedding", (tp_axis, f)),
+        ]
+    rules.append((r".*", (f,) if f else ()))
+    return rules

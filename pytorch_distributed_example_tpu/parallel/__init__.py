@@ -23,7 +23,9 @@ from .tensor_parallel import (  # noqa: F401
     ColwiseParallel,
     RowwiseParallel,
     SequenceParallel,
+    loss_parallel,
     parallelize_module,
+    vocab_parallel_cross_entropy,
 )
 from .context_parallel import (  # noqa: F401
     make_cp_attention,

@@ -123,9 +123,59 @@ def mlp_block_tp(x, w_up_local, w_down_local, axis: str = "tp", act=None):
 
 
 def vocab_parallel_logits(h, emb_local, axis: str = "tp"):
-    """Vocab-parallel LM head: local logits chunk, all-gathered on last dim."""
+    """Vocab-parallel LM head: local logits chunk, all-gathered on last dim.
+
+    Prefer `vocab_parallel_cross_entropy` when the logits only feed a
+    loss: it never materializes the (..., V) gather at all."""
     import jax.numpy as jnp
     from jax import lax
 
     local = jnp.dot(h, emb_local, preferred_element_type=jnp.float32)
     return lax.all_gather(local, axis, axis=local.ndim - 1, tiled=True)
+
+
+def vocab_parallel_cross_entropy(
+    local_logits, targets, axis: str = "tp", ignore_index: int = -100
+):
+    """Cross-entropy against vocab-SHARDED logits, no full-vocab gather.
+
+    Parity: torch `loss_parallel()` (`torch/distributed/tensor/parallel/
+    loss.py`), Megatron's vocab-parallel CE. Inside shard_map:
+    `local_logits` is this rank's (..., V/W) vocab chunk (rank-contiguous
+    shards), `targets` GLOBAL vocab ids. The global logsumexp needs one
+    pmax (detached max, the standard stability shift) + one psum, and the
+    target logit one masked psum — bytes on wire are O(batch), not
+    O(batch x vocab) as the all_gather path. Gradients flow through the
+    psums: d/dlocal = softmax_chunk - local_onehot, exactly the dense CE
+    gradient's shard. Returns per-element losses (same shape as targets);
+    positions where `targets == ignore_index` (torch's padding
+    convention) contribute 0 loss and 0 gradient.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    V_local = local_logits.shape[-1]
+    offset = lax.axis_index(axis) * V_local
+
+    # global max, detached (logsumexp shift). stop_gradient must wrap the
+    # INPUT: pmax has no differentiation rule, and a zero tangent skips it
+    m = lax.pmax(lax.stop_gradient(local_logits.max(axis=-1)), axis)
+    z = lax.psum(
+        jnp.exp(local_logits - m[..., None]).sum(axis=-1), axis
+    )  # global sum of exp
+
+    local_idx = targets - offset
+    in_shard = (local_idx >= 0) & (local_idx < V_local)
+    safe_idx = jnp.clip(local_idx, 0, V_local - 1)
+    picked = jnp.take_along_axis(
+        local_logits, safe_idx[..., None], axis=-1
+    )[..., 0]
+    target_logit = lax.psum(jnp.where(in_shard, picked, 0.0), axis)
+
+    loss = jnp.log(z) + m - target_logit
+    # ignored positions: 0 loss AND 0 grad (the where's constant branch)
+    return jnp.where(targets == ignore_index, jnp.zeros_like(loss), loss)
+
+
+# torch.distributed.tensor.parallel.loss_parallel-shaped alias
+loss_parallel = vocab_parallel_cross_entropy

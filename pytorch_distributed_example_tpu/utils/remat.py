@@ -48,17 +48,29 @@ def checkpoint_wrapper(
 
 
 def apply_activation_checkpointing(
-    apply_fn: Callable, check_fn: Optional[Callable[[str], bool]] = None
+    apply_fn: Callable,
+    check_fn: Optional[Callable[[str], bool]] = None,
+    policy: str = "nothing",
+    **static_kwargs,
 ) -> Callable:
     """torch `apply_activation_checkpointing(model, check_fn=...)` shape:
-    wrap a flax `apply` so the whole forward is rematerialized. Per-layer
-    selection belongs model-side (`TransformerConfig(remat=True)` remats
-    each Block); `check_fn` is accepted for API parity and must be None
-    here — selective wrapping of arbitrary submodules has no functional
-    analog at this seam."""
+    wrap a flax `apply` so the whole forward is rematerialized.
+
+    Python-level flags (`train=True`, `deterministic=False`, ...) must be
+    STATIC under `jax.checkpoint` — flax Dropout branches on them — so
+    pass them here as keyword arguments and they are bound before the
+    remat wrap: ``fwd = apply_activation_checkpointing(m.apply,
+    train=True)``. Per-layer selection belongs model-side
+    (`TransformerConfig(remat=True)` remats each Block); `check_fn` is
+    accepted for API parity and must be None here — selective wrapping of
+    arbitrary submodules has no functional analog at this seam."""
     if check_fn is not None:
         raise NotImplementedError(
             "per-submodule selection: use the model's remat config "
             "(e.g. TransformerConfig(remat=True)) instead"
         )
-    return checkpoint_wrapper(apply_fn)
+    if static_kwargs:
+        base = lambda *args: apply_fn(*args, **static_kwargs)
+    else:
+        base = apply_fn
+    return checkpoint_wrapper(base, policy=policy)

@@ -138,6 +138,155 @@ class TestTransformerLM:
         np.testing.assert_allclose(np.asarray(ld), np.asarray(lf), rtol=2e-4, atol=2e-4)
 
 
+class TestBert:
+    """BERT encoder (BASELINE config #4 model family): bidirectional
+    attention, padding-mask semantics, fine-tune convergence, TP layout."""
+
+    def _cfg(self):
+        from pytorch_distributed_example_tpu.models import BertConfig
+
+        return BertConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            max_seq_len=32, dropout=0.0,
+        )
+
+    def test_forward_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import BertEncoder
+
+        cfg = self._cfg()
+        m = BertEncoder(cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (3, 16)))
+        p = m.init(jax.random.PRNGKey(0), ids)
+        h, pooled = m.apply(p, ids)
+        assert h.shape == (3, 16, 32) and pooled.shape == (3, 32)
+
+    def test_attention_is_bidirectional(self):
+        """Perturbing a LATE token must change EARLY positions' hidden
+        states — the defining non-causal property."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import BertEncoder
+
+        cfg = self._cfg()
+        m = BertEncoder(cfg)
+        gen = np.random.default_rng(1)
+        ids = jnp.asarray(gen.integers(2, 128, (1, 16)))
+        p = m.init(jax.random.PRNGKey(0), ids)
+        h1, _ = m.apply(p, ids)
+        ids2 = ids.at[0, 12].set((int(ids[0, 12]) + 1) % 128)
+        h2, _ = m.apply(p, ids2)
+        # position 3 (well before 12) must differ
+        assert float(jnp.abs(h1[0, 3] - h2[0, 3]).max()) > 1e-6
+
+    def test_padding_mask_blocks_attention(self):
+        """Masked (pad) keys must not influence unmasked positions."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import BertEncoder
+
+        cfg = self._cfg()
+        m = BertEncoder(cfg)
+        gen = np.random.default_rng(2)
+        ids = jnp.asarray(gen.integers(2, 128, (1, 16)))
+        mask = jnp.asarray([[1] * 10 + [0] * 6])
+        p = m.init(jax.random.PRNGKey(0), ids)
+        h1, _ = m.apply(p, ids, attention_mask=mask)
+        # scramble the padded tail: real positions must be unaffected
+        ids2 = ids.at[0, 12:].set(jnp.asarray(gen.integers(2, 128, 4)))
+        h2, _ = m.apply(p, ids2, attention_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(h1[0, :10]), np.asarray(h2[0, :10]), atol=1e-5
+        )
+
+    def test_ddp_finetune_loss_falls(self, world):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_example_tpu as tdx
+        from pytorch_distributed_example_tpu.models import (
+            BertForSequenceClassification,
+        )
+
+        cfg = self._cfg()
+        m = BertForSequenceClassification(cfg, num_labels=2)
+        gen = np.random.default_rng(3)
+        ids0 = jnp.asarray(gen.integers(0, 128, (1, 16)))
+        p = m.init(jax.random.PRNGKey(0), ids0)
+        ddp = tdx.DistributedDataParallel(m, p)
+        opt = optax.adam(1e-3)
+        step = ddp.make_train_step(
+            opt,
+            lambda lg, y: optax.softmax_cross_entropy_with_integer_labels(
+                lg, y
+            ).mean(),
+        )
+        W = world.size()
+        x = jnp.asarray(gen.integers(0, 128, (4 * W, 16)))
+        y = jnp.asarray(gen.integers(0, 2, 4 * W), jnp.int32)
+        pp, st = ddp.params, opt.init(ddp.params)
+        losses = []
+        for _ in range(8):
+            pp, st, loss = step(pp, st, x, y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_tp_sharding_layout(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+        from pytorch_distributed_example_tpu.models import (
+            BertEncoder,
+            bert_sharding_rules,
+        )
+        from pytorch_distributed_example_tpu.parallel import sharding as shd
+
+        cfg = self._cfg()
+        m = BertEncoder(cfg)
+        ids = jnp.asarray(np.random.default_rng(4).integers(0, 128, (1, 8)))
+        p = m.init(jax.random.PRNGKey(0), ids)
+        mesh = init_device_mesh(("fsdp", "tp"), (4, 2))
+        sharded, specs = shd.shard_params(
+            p, mesh, bert_sharding_rules("tp", None)
+        )
+        qk = sharded["params"]["layer_0"]["attn"]["query"]["kernel"]
+        assert {s.data.shape for s in qk.addressable_shards} == {(32, 16)}
+        emb = sharded["params"]["tok_emb"]["embedding"]
+        assert {s.data.shape for s in emb.addressable_shards} == {(64, 32)}
+
+    def test_2d_fsdp_tp_layout_shards_both_axes(self):
+        """fsdp_axis must actually reach the big kernels: each (fsdp=4,
+        tp=2) position holds a 1/8 tile, not a tp-only 1/2 slice."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+        from pytorch_distributed_example_tpu.models import (
+            BertEncoder,
+            bert_sharding_rules,
+        )
+        from pytorch_distributed_example_tpu.parallel import sharding as shd
+
+        cfg = self._cfg()
+        m = BertEncoder(cfg)
+        ids = jnp.asarray(np.random.default_rng(5).integers(0, 128, (1, 8)))
+        p = m.init(jax.random.PRNGKey(0), ids)
+        mesh = init_device_mesh(("fsdp", "tp"), (4, 2))
+        sharded, _ = shd.shard_params(
+            p, mesh, bert_sharding_rules("tp", "fsdp")
+        )
+        qk = sharded["params"]["layer_0"]["attn"]["query"]["kernel"]  # (32,32)
+        assert {s.data.shape for s in qk.addressable_shards} == {(8, 16)}
+        dn = sharded["params"]["layer_0"]["mlp_down"]["kernel"]  # (64,32)
+        assert {s.data.shape for s in dn.addressable_shards} == {(32, 8)}
+
+
 class TestShardedTransformer:
     def test_2d_sharded_step_matches_unsharded(self):
         """fsdp x tp GSPMD train step == single-device step (same numbers)."""

@@ -67,3 +67,35 @@ class TestCheckpointWrapper:
         np.testing.assert_allclose(np.asarray(g), 1 - np.tanh(1.0) ** 2, rtol=1e-5)
         with pytest.raises(NotImplementedError):
             apply_activation_checkpointing(lambda x: x, check_fn=lambda n: True)
+
+    def test_static_kwargs_bind_train_flag(self):
+        """Flax apply with dropout: train=True must be bound statically —
+        this is THE use activation checkpointing exists for."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import (
+            BertConfig,
+            BertEncoder,
+        )
+
+        cfg = BertConfig(
+            vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            max_seq_len=8, dropout=0.1,
+        )
+        m = BertEncoder(cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 32, (2, 8)))
+        p = m.init(jax.random.PRNGKey(0), ids)
+        fwd = apply_activation_checkpointing(
+            m.apply, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+        )
+
+        def loss(p):
+            h, _ = fwd(p, ids)
+            return (h**2).sum()
+
+        g = jax.jit(jax.grad(loss))(p)
+        flat = np.concatenate(
+            [np.asarray(l).ravel() for l in jax.tree_util.tree_leaves(g)]
+        )
+        assert np.isfinite(flat).all() and np.abs(flat).sum() > 0
