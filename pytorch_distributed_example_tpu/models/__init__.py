@@ -6,6 +6,7 @@ from .bert import (  # noqa: F401
 )
 from .convnet import ConvNet  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50  # noqa: F401
+from .generate import generate, init_cache  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig,
     TransformerLM,

@@ -102,7 +102,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, decode: bool = False):
         cfg = self.cfg
         B, L, _ = x.shape
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -112,13 +112,17 @@ class Attention(nn.Module):
         q = dense(H * Dh, "q_proj")(x).reshape(B, L, H, Dh)
         k = dense(KV * Dh, "k_proj")(x).reshape(B, L, KV, Dh)
         v = dense(KV * Dh, "v_proj")(x).reshape(B, L, KV, Dh)
+        scale = 1.0 / (Dh ** 0.5)
+
+        if decode:
+            return self._decode(q, k, v, cos, sin, scale, dense)
+
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if KV != H:  # GQA: repeat kv groups to full heads
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        scale = 1.0 / (Dh ** 0.5)
         if cfg.use_flash and _flash_ok(L, Dh):
             from ..ops import flash_attention
 
@@ -126,6 +130,60 @@ class Attention(nn.Module):
         else:
             o = _dense_attention(q, k, v, cfg.causal, scale)
         o = o.reshape(B, L, H * Dh)
+        return dense(cfg.d_model, "o_proj")(o)
+
+    def _decode(self, q, k, v, cos, sin, scale, dense):
+        """KV-cache step: write this call's K/V at the running index into
+        static (B, max_seq_len) buffers (flax "cache" collection), attend
+        causally over the cache. One code path serves prefill (L = prompt
+        length at index 0) and decode (L = 1) — static shapes throughout,
+        so XLA compiles exactly two programs for the whole generate loop.
+        cos/sin must cover max_seq_len; RoPE uses ABSOLUTE positions via a
+        dynamic slice at the cache index."""
+        from jax import lax
+
+        cfg = self.cfg
+        B, L, KV, Dh = k.shape
+        H = cfg.n_heads
+        M = cfg.max_seq_len
+        # flax decode-cache convention: during init (variables not yet
+        # present) only CREATE them — persisting the write would hand the
+        # caller a cache whose index already advanced past the init input
+        is_initialized = self.has_variable("cache", "k")
+        ck = self.variable(
+            "cache", "k", jnp.zeros, (B, M, KV, Dh), k.dtype
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros, (B, M, KV, Dh), v.dtype
+        )
+        ci = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = ci.value
+
+        pos_cos = lax.dynamic_slice_in_dim(cos, idx, L, axis=0)
+        pos_sin = lax.dynamic_slice_in_dim(sin, idx, L, axis=0)
+        q = apply_rope(q, pos_cos, pos_sin)
+        k = apply_rope(k, pos_cos, pos_sin)
+
+        kf = lax.dynamic_update_slice_in_dim(ck.value, k, idx, axis=1)
+        vf = lax.dynamic_update_slice_in_dim(cv.value, v, idx, axis=1)
+        if is_initialized:
+            ck.value = kf
+            cv.value = vf
+            ci.value = idx + L
+        # GQA: group the query heads and attend against the UN-repeated
+        # cache — repeating the (B, M, KV, Dh) buffers up to H heads per
+        # step would forfeit the KV-cache bandwidth saving GQA exists for
+        rep = H // KV
+        qg = q.reshape(B, L, KV, rep, Dh)
+        s = jnp.einsum("blkrd,bmkd->bkrlm", qg, kf) * scale  # (B,KV,rep,L,M)
+        key_pos = jnp.arange(M)
+        q_pos = idx + jnp.arange(L)
+        mask = key_pos[None, :] <= q_pos[:, None]  # causal over the cache
+        s = jnp.where(mask[None, None, None], s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vf.dtype)
+        o = jnp.einsum("bkrlm,bmkd->blkrd", p, vf).reshape(B, L, H * Dh)
         return dense(cfg.d_model, "o_proj")(o)
 
 
@@ -188,9 +246,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, decode: bool = False):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin)
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin, decode
+        )
         mlp_cls = MoE if cfg.n_experts > 0 else MLP
         x = x + mlp_cls(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
         return x
@@ -200,16 +260,22 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
-        """tokens: (B, L) int32 → logits (B, L, vocab) fp32."""
+    def __call__(self, tokens, decode: bool = False):
+        """tokens: (B, L) int32 → logits (B, L, vocab) fp32.
+
+        `decode=True` switches attention to the KV-cache path (flax
+        "cache" collection; apply with `mutable=["cache"]`): call once
+        with the prompt (prefill), then with one token at a time —
+        `models/generate.py` wraps the loop."""
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_embed"
         )(tokens)
-        cos, sin = rope_freqs(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
-        block_cls = nn.remat(Block) if cfg.remat else Block
+        rope_len = cfg.max_seq_len if decode else tokens.shape[1]
+        cos, sin = rope_freqs(cfg.head_dim, rope_len, cfg.rope_theta)
+        block_cls = nn.remat(Block) if (cfg.remat and not decode) else Block
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin)
+            x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin, decode)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
