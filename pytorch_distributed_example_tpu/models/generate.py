@@ -19,6 +19,9 @@ from __future__ import annotations
 from typing import Any, Optional
 
 
+import functools
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     import jax
     import jax.numpy as jnp
@@ -27,27 +30,21 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        k = min(top_k, logits.shape[-1])  # HF convention: clamp to vocab
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-# (id(model), temperature, top_k, eos_id) -> (model_ref, prefill, decode).
-# The strong model_ref keeps id() stable for the entry's lifetime.
-_PROGRAMS: dict = {}
-
-
+@functools.lru_cache(maxsize=32)
 def _programs(model, temperature: float, top_k: Optional[int], eos_id):
-    import functools
-
+    """Jitted prefill/decode pair per (model, sampling knobs). flax
+    Modules are frozen dataclasses — hashable, equal by config — so the
+    lru_cache dedupes equal-config models AND bounds growth (each entry
+    anchors compiled XLA executables)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
-
-    key = (id(model), temperature, top_k, eos_id)
-    hit = _PROGRAMS.get(key)
-    if hit is not None:
-        return hit[1], hit[2]
 
     @jax.jit
     def prefill(params, cache, prompt, rng):
@@ -78,26 +75,30 @@ def _programs(model, temperature: float, top_k: Optional[int], eos_id):
         _, rest = lax.scan(step, (cache, first, done, rng), None, length=length)
         return rest.T  # (B, length)
 
-    _PROGRAMS[key] = (model, prefill, decode)
     return prefill, decode
 
 
 def init_cache(model, batch_size: int):
-    """Empty KV cache for `model` at this batch size — shapes via
-    `jax.eval_shape` (no parameter materialization), values zeros."""
-    import jax
+    """Empty KV cache for `model` at this batch size — built directly
+    from the config (per layer: (B, max_seq_len, kv_heads, head_dim) K/V
+    + index), no model trace on the request path. The structure mirrors
+    the module tree; `test_generate.py` pins it against
+    `model.init(decode=True)` so drift fails loudly."""
     import jax.numpy as jnp
 
-    shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((batch_size, 1), jnp.int32),
-            decode=True,
-        )
-    )["cache"]
-    return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes
-    )
+    cfg = model.cfg
+    B, M, KV, Dh = batch_size, cfg.max_seq_len, cfg.kv_heads, cfg.head_dim
+
+    def one_layer():
+        return {
+            "attn": {
+                "k": jnp.zeros((B, M, KV, Dh), cfg.dtype),
+                "v": jnp.zeros((B, M, KV, Dh), cfg.dtype),
+                "index": jnp.zeros((), jnp.int32),
+            }
+        }
+
+    return {f"layers_{i}": one_layer() for i in range(cfg.n_layers)}
 
 
 def generate(

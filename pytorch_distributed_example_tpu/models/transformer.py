@@ -143,6 +143,12 @@ class Attention(nn.Module):
         from jax import lax
 
         cfg = self.cfg
+        if not cfg.causal:
+            raise ValueError(
+                "decode=True requires a causal model (the KV-cache step "
+                "attends positions <= index); causal=False configs have "
+                "no autoregressive decode"
+            )
         B, L, KV, Dh = k.shape
         H = cfg.n_heads
         M = cfg.max_seq_len

@@ -144,18 +144,73 @@ class TestGenerate:
                 assert (row[hits[0] :] == eos).all()
 
     def test_program_cache_reused_across_calls(self):
-        """Two same-shape generate() calls share the cached jitted
-        programs (no per-call retrace)."""
+        """Two same-knob generate() calls share the cached jitted
+        programs (lru_cache keyed on the hashable model)."""
         import jax
 
         from pytorch_distributed_example_tpu.models import generate
-        from pytorch_distributed_example_tpu.models.generate import _PROGRAMS
+        from pytorch_distributed_example_tpu.models.generate import _programs
 
         model, params, toks = _model()
         generate(model, params, toks[:, :4], 3, rng=jax.random.PRNGKey(0))
-        n = len(_PROGRAMS)
+        before = _programs.cache_info().hits
         generate(model, params, toks[:, :4], 3, rng=jax.random.PRNGKey(1))
-        assert len(_PROGRAMS) == n  # same entry reused
+        assert _programs.cache_info().hits > before
+
+    def test_init_cache_matches_model_structure(self):
+        """The config-derived cache tree must stay bit-identical in
+        structure/shape/dtype to what the model's own init creates."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import init_cache
+
+        model, params, toks = _model(n_kv_heads=2)
+        want = jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                decode=True,
+            )
+        )["cache"]
+        got = init_cache(model, 2)
+        wl, wt = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_map(lambda s: (s.shape, str(s.dtype)), want)
+        )
+        gl, gt = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_map(
+                lambda a: (a.shape, str(a.dtype)), got
+            )
+        )
+        assert wt == gt and wl == gl
+
+    def test_topk_clamped_to_vocab(self):
+        from pytorch_distributed_example_tpu.models import generate
+
+        model, params, toks = _model()
+        out = generate(
+            model, params, toks[:, :4], 3, temperature=0.9, top_k=10_000
+        )
+        assert out.shape == (2, 3)
+
+    def test_decode_rejected_for_non_causal(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+            max_seq_len=16, causal=False, use_flash=False,
+        )
+        model = TransformerLM(cfg)
+        with pytest.raises(ValueError, match="causal"):
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                decode=True,
+            )
 
     def test_length_budget_enforced(self):
         from pytorch_distributed_example_tpu.models import generate

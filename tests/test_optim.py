@@ -16,11 +16,11 @@ W = 8
 
 @pytest.fixture()
 def pg():
-    if tdx.is_initialized():
-        tdx.destroy_process_group()
-    tdx.init_process_group(backend="xla", world_size=W)
+    # REUSE the session's default group — destroying it here would strand
+    # every later test holding the session-scoped `world` fixture's object
+    if not tdx.is_initialized():
+        tdx.init_process_group(backend="xla", world_size=W)
     yield
-    tdx.destroy_process_group()
 
 
 class TestZeroRedundancyOptimizer:
