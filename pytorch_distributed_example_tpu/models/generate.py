@@ -5,8 +5,8 @@ length — one prefill (whole prompt through the cache path) and one
 decode body (single token), the decode loop a `lax.scan` so sampling,
 cache updates, and EOS bookkeeping all live on device. The jitted
 programs are cached per (model, sampling knobs), NOT per call, so a
-serving loop pays compilation once; the empty KV cache is built from
-`jax.eval_shape` (no throwaway parameter init). Static shapes
+serving loop pays compilation once; the empty KV cache is built
+directly from the config (no model trace on the request path). Static shapes
 throughout: the cache is (B, max_seq_len) from construction and the
 output is always (B, max_new_tokens), EOS-padded.
 

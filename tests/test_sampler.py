@@ -144,6 +144,86 @@ class TestDataLoaderPrefetch:
         it.close()  # generator close must shut the pool down cleanly
 
 
+class TestDatasetCombinators:
+    """torch.utils.data staples: TensorDataset/Subset/ConcatDataset/
+    random_split, incl. the batch-indexing convention the loader uses."""
+
+    def test_tensor_dataset_batch_indexing(self):
+        from pytorch_distributed_example_tpu.data import TensorDataset
+
+        x = np.arange(20).reshape(10, 2)
+        y = np.arange(10)
+        ds = TensorDataset(x, y)
+        assert len(ds) == 10
+        bx, by = ds[np.array([3, 1, 7])]
+        np.testing.assert_array_equal(bx, x[[3, 1, 7]])
+        np.testing.assert_array_equal(by, [3, 1, 7])
+        with pytest.raises(ValueError):
+            TensorDataset(x, np.arange(9))
+
+    def test_subset_and_random_split(self):
+        from pytorch_distributed_example_tpu.data import (
+            Subset,
+            TensorDataset,
+            random_split,
+        )
+
+        ds = TensorDataset(np.arange(30).reshape(10, 3), np.arange(10))
+        a, b = random_split(ds, [7, 3], seed=5)
+        assert len(a) == 7 and len(b) == 3
+        seen = set(a.indices.tolist()) | set(b.indices.tolist())
+        assert seen == set(range(10))  # disjoint cover
+        sub = Subset(ds, [9, 0])
+        bx, by = sub[np.array([0, 1])]
+        np.testing.assert_array_equal(by, [9, 0])
+        with pytest.raises(ValueError):
+            random_split(ds, [5, 4])
+
+    def test_concat_dataset_restitches_order(self):
+        from pytorch_distributed_example_tpu.data import (
+            ConcatDataset,
+            TensorDataset,
+        )
+
+        d1 = TensorDataset(np.arange(6).reshape(3, 2), np.array([0, 1, 2]))
+        d2 = TensorDataset(
+            np.arange(100, 108).reshape(4, 2), np.array([10, 11, 12, 13])
+        )
+        cd = ConcatDataset([d1, d2])
+        assert len(cd) == 7
+        _, y = cd[4]
+        assert y == 11  # single index crosses the boundary
+        bx, by = cd[np.array([5, 0, 3, 2])]  # interleaved sources
+        np.testing.assert_array_equal(by, [12, 0, 10, 2])
+        np.testing.assert_array_equal(bx[1], [0, 1])
+        # torch-style negative indexing reaches the RIGHT source
+        _, y_last = cd[-1]
+        assert y_last == 13
+        _, by_neg = cd[np.array([-1, -7])]
+        np.testing.assert_array_equal(by_neg, [13, 0])
+        # empty batch yields empty columns, out-of-range raises
+        ex, ey = cd[np.array([], dtype=int)]
+        assert len(ex) == 0 and len(ey) == 0
+        with pytest.raises(IndexError):
+            cd[7]
+        with pytest.raises(IndexError):
+            cd[np.array([0, -8])]
+
+    def test_combinators_feed_the_loader(self):
+        from pytorch_distributed_example_tpu.data import (
+            ConcatDataset,
+            DataLoader,
+            TensorDataset,
+        )
+
+        d1 = TensorDataset(np.ones((8, 2)), np.zeros(8))
+        d2 = TensorDataset(np.full((8, 2), 2.0), np.ones(8))
+        batches = list(DataLoader(ConcatDataset([d1, d2]), 4, num_workers=2))
+        assert len(batches) == 4
+        total = np.concatenate([b[1] for b in batches])
+        assert total.sum() == 8  # all of d2's labels seen once
+
+
 class TestTorchOracle:
     """Structural equivalence with torch.utils.data.DistributedSampler."""
 
