@@ -30,7 +30,10 @@ def main():
     import optax
 
     import pytorch_distributed_example_tpu as tdx
-    from pytorch_distributed_example_tpu.models import ResNet18
+    from pytorch_distributed_example_tpu.models import (
+        ResNet18,
+        convert_sync_batchnorm,
+    )
     from benchmarks.common import emit
 
     if not tdx.is_initialized():
@@ -39,7 +42,11 @@ def main():
     gb = args.batch * W
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    model = ResNet18(num_classes=10, dtype=dtype)
+    # sync BN: per-device batches normalize with GLOBAL statistics (one
+    # psum per norm inside the step) — torch's DDP+SyncBatchNorm recipe
+    model = convert_sync_batchnorm(
+        ResNet18(num_classes=10, dtype=dtype), axis_name="_ranks"
+    )
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
     opt = optax.sgd(0.1, momentum=0.9)
 
@@ -63,7 +70,7 @@ def main():
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "_ranks"), grads)
-        new_stats = jax.tree_util.tree_map(lambda s: jax.lax.pmean(s, "_ranks"), new_stats)
+        # batch_stats already agree across ranks (sync BN psums inside)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, jax.lax.pmean(loss, "_ranks")

@@ -48,7 +48,10 @@ def main():
 
     import pytorch_distributed_example_tpu as tdx
     from pytorch_distributed_example_tpu.data import DataLoader
-    from pytorch_distributed_example_tpu.models import ResNet18
+    from pytorch_distributed_example_tpu.models import (
+        ResNet18,
+        convert_sync_batchnorm,
+    )
     from pytorch_distributed_example_tpu._compat import shard_map_fn
     from jax.sharding import PartitionSpec as P
 
@@ -62,7 +65,11 @@ def main():
     print(f"backend={tdx.get_backend()} world_size={W} devices={jax.devices()[:W]}")
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    model = ResNet18(num_classes=10, dtype=dtype)
+    # sync BN: normalize with global batch statistics (torch's
+    # DDP + SyncBatchNorm recipe); stats agree across ranks by design
+    model = convert_sync_batchnorm(
+        ResNet18(num_classes=10, dtype=dtype), axis_name="_ranks"
+    )
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
     opt = optax.sgd(args.lr, momentum=args.momentum)
 
@@ -79,7 +86,6 @@ def main():
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "_ranks"), grads)
-        new_stats = jax.tree_util.tree_map(lambda s: jax.lax.pmean(s, "_ranks"), new_stats)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, jax.lax.pmean(loss, "_ranks")
 

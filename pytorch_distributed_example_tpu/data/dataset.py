@@ -77,25 +77,26 @@ class ConcatDataset:
                 raise IndexError(f"index {i} out of range for size {n}")
             ds, local = self._locate(i + n if i < 0 else i)
             return self.datasets[ds][local]
-        idx = np.asarray(idx)
+        idx = np.asarray(idx, dtype=np.intp)
         if len(idx) == 0:  # empty batch: empty columns, not a crash
             return self.datasets[0][idx]
         if ((idx < -n) | (idx >= n)).any():
             raise IndexError(f"index out of range for size {n}")
         idx = np.where(idx < 0, idx + n, idx)  # torch-style negatives
-        out = [None] * len(idx)
         which = np.searchsorted(self.cumsizes, idx, side="right")
+        cols = None
         for ds in np.unique(which):
             sel = np.nonzero(which == ds)[0]
             prev = 0 if ds == 0 else int(self.cumsizes[ds - 1])
             rows = self.datasets[ds][idx[sel] - prev]
-            # rows is a tuple of stacked columns; scatter back in order
-            for j, pos in enumerate(sel):
-                out[pos] = tuple(col[j] for col in rows)
-        cols = len(out[0])
-        return tuple(
-            np.stack([row[c] for row in out]) for c in range(cols)
-        )
+            if cols is None:  # allocate each output column once
+                cols = [
+                    np.empty((len(idx),) + col.shape[1:], col.dtype)
+                    for col in rows
+                ]
+            for out_col, col in zip(cols, rows):
+                out_col[sel] = col  # one vectorized scatter per column
+        return tuple(cols)
 
 
 def random_split(dataset, lengths: Sequence[int], seed: int = 0):

@@ -5,7 +5,13 @@ from .bert import (  # noqa: F401
     bert_sharding_rules,
 )
 from .convnet import ConvNet  # noqa: F401
-from .resnet import ResNet, ResNet18, ResNet34, ResNet50  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    convert_sync_batchnorm,
+)
 from .generate import generate, init_cache  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig,

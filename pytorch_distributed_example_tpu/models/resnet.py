@@ -71,6 +71,10 @@ class ResNet(nn.Module):
     num_classes: int = 10
     num_filters: int = 64
     dtype: Any = jnp.float32
+    # SyncBatchNorm: name a mapped mesh axis (e.g. the DDP step's axis)
+    # and BatchNorm statistics are psum'd across it — torch
+    # `nn.SyncBatchNorm` semantics (see `convert_sync_batchnorm`)
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -81,6 +85,7 @@ class ResNet(nn.Module):
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
+            axis_name=self.bn_axis_name if train else None,
         )
         x = conv(self.num_filters, (3, 3), name="conv_init")(x)
         x = norm(name="bn_init")(x)
@@ -98,6 +103,19 @@ class ResNet(nn.Module):
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)
+
+
+def convert_sync_batchnorm(model: ResNet, axis_name: str = "_ranks") -> ResNet:
+    """torch `SyncBatchNorm.convert_sync_batchnorm(model)`: returns a copy
+    whose BatchNorm layers reduce batch statistics across `axis_name`
+    (flax `BatchNorm(axis_name=...)` — one psum of (mean, mean-of-squares)
+    per norm, the same wire cost as torch's sync BN). Use the mapped axis
+    of the step that will run it: the DDP compiled step's axis is
+    `"_ranks"` (the default); params are unchanged, so conversion works
+    on an already-initialized model."""
+    import dataclasses
+
+    return dataclasses.replace(model, bn_axis_name=axis_name)
 
 
 def ResNet18(**kw) -> ResNet:

@@ -138,6 +138,91 @@ class TestTransformerLM:
         np.testing.assert_allclose(np.asarray(ld), np.asarray(lf), rtol=2e-4, atol=2e-4)
 
 
+class TestSyncBatchNorm:
+    """convert_sync_batchnorm: per-device sub-batches under shard_map
+    must produce the SAME normalization and running stats as the full
+    batch on one device — torch SyncBatchNorm's defining property
+    (plain per-replica BN diverges here)."""
+
+    def test_sharded_stats_match_full_batch(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+        from pytorch_distributed_example_tpu.models import (
+            ResNet18,
+            convert_sync_batchnorm,
+        )
+
+        mesh = init_device_mesh(("dp",), (8,))
+        gen = np.random.default_rng(0)
+        x = jnp.asarray(gen.standard_normal((16, 32, 32, 3)), jnp.float32)
+
+        plain = ResNet18(num_classes=10)
+        variables = plain.init(jax.random.PRNGKey(0), x[:1])
+        want, wmut = plain.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+
+        synced = convert_sync_batchnorm(plain, axis_name="dp")
+
+        def local(params, stats, xs):
+            out, mut = synced.apply(
+                {"params": params, "batch_stats": stats},
+                xs,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return out, mut["batch_stats"]
+
+        mapped = shard_map_fn(
+            local,
+            mesh=mesh.jax_mesh,
+            in_specs=(P(), P(), P("dp")),
+            out_specs=(P("dp"), P()),
+        )
+        got, gstats = jax.jit(mapped)(
+            variables["params"], variables["batch_stats"], x
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(gstats),
+            jax.tree_util.tree_leaves(wmut["batch_stats"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_plain_bn_diverges_without_sync(self):
+        """Control: WITHOUT conversion the per-shard stats differ from
+        the full batch — proving the sync actually does something."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.models import ResNet18
+
+        gen = np.random.default_rng(1)
+        x = jnp.asarray(gen.standard_normal((16, 32, 32, 3)), jnp.float32)
+        plain = ResNet18(num_classes=10)
+        variables = plain.init(jax.random.PRNGKey(0), x[:1])
+        _, full = plain.apply(variables, x, train=True, mutable=["batch_stats"])
+        _, shard = plain.apply(
+            variables, x[:2], train=True, mutable=["batch_stats"]
+        )
+        diffs = [
+            float(jnp.abs(a - b).max())
+            for a, b in zip(
+                jax.tree_util.tree_leaves(full["batch_stats"]),
+                jax.tree_util.tree_leaves(shard["batch_stats"]),
+            )
+        ]
+        assert max(diffs) > 1e-4
+
+
 class TestBert:
     """BERT encoder (BASELINE config #4 model family): bidirectional
     attention, padding-mask semantics, fine-tune convergence, TP layout."""
