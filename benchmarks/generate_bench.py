@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--new", type=int, default=128)
     ap.add_argument("--bf16", action="store_true")
     args = ap.parse_args()
+    if args.new < 2:
+        ap.error("--new must be >= 2 (decode-only timing subtracts a "
+                 "prefill-only call; --new 1 has no decode loop to measure)")
 
     import jax
     import jax.numpy as jnp

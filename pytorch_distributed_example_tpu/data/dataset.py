@@ -84,18 +84,26 @@ class ConcatDataset:
             raise IndexError(f"index out of range for size {n}")
         idx = np.where(idx < 0, idx + n, idx)  # torch-style negatives
         which = np.searchsorted(self.cumsizes, idx, side="right")
-        cols = None
+        gathered = []  # (positions in the request, that source's rows)
         for ds in np.unique(which):
             sel = np.nonzero(which == ds)[0]
             prev = 0 if ds == 0 else int(self.cumsizes[ds - 1])
-            rows = self.datasets[ds][idx[sel] - prev]
-            if cols is None:  # allocate each output column once
-                cols = [
-                    np.empty((len(idx),) + col.shape[1:], col.dtype)
-                    for col in rows
-                ]
-            for out_col, col in zip(cols, rows):
-                out_col[sel] = col  # one vectorized scatter per column
+            gathered.append((sel, self.datasets[ds][idx[sel] - prev]))
+        ncols = len(gathered[0][1])
+        cols = []
+        for c in range(ncols):
+            parts = [rows[c] for _, rows in gathered]
+            shapes = {p.shape[1:] for p in parts}
+            if len(shapes) > 1:  # no silent broadcast across sources
+                raise ValueError(
+                    f"column {c} row shapes differ across datasets: {shapes}"
+                )
+            out = np.empty(
+                (len(idx),) + parts[0].shape[1:], np.result_type(*parts)
+            )
+            for (sel, _), p in zip(gathered, parts):
+                out[sel] = p  # one vectorized scatter per source
+            cols.append(out)
         return tuple(cols)
 
 

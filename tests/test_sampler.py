@@ -209,6 +209,26 @@ class TestDatasetCombinators:
         with pytest.raises(IndexError):
             cd[np.array([0, -8])]
 
+    def test_concat_promotes_dtype_and_rejects_shape_mismatch(self):
+        from pytorch_distributed_example_tpu.data import (
+            ConcatDataset,
+            TensorDataset,
+        )
+
+        d64 = TensorDataset(np.ones((2, 3), np.float64), np.zeros(2))
+        d32 = TensorDataset(np.full((2, 3), 2.0, np.float32), np.ones(2))
+        cd = ConcatDataset([d64, d32])
+        bx, _ = cd[np.array([0, 3])]  # one row from each source
+        assert bx.dtype == np.float64  # promoted, not silently downcast
+        np.testing.assert_array_equal(bx[1], np.full(3, 2.0))
+
+        bad = ConcatDataset(
+            [TensorDataset(np.ones((2, 3)), np.zeros(2)),
+             TensorDataset(np.ones((2, 4)), np.zeros(2))]
+        )
+        with pytest.raises(ValueError, match="shapes differ"):
+            bad[np.array([0, 2])]
+
     def test_combinators_feed_the_loader(self):
         from pytorch_distributed_example_tpu.data import (
             ConcatDataset,
