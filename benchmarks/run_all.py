@@ -1,0 +1,180 @@
+"""Run every benchmark, collect the JSON lines, write one results file.
+
+One command gathers the round's full perf evidence the moment a TPU is
+reachable (the tunnel flaps; see bench.py's defensive bring-up):
+
+    python benchmarks/run_all.py [--out benchmarks/results.json] [--quick]
+
+Each bench runs in its OWN subprocess with a timeout — a hung TPU init
+or a crash in one config cannot take down the sweep — and the last JSON
+line of its stdout is recorded (with rc/stderr tail on failure). The
+headline `bench.py` (DDP MNIST + MFU) runs first; `--quick` shrinks
+steps for a fast smoke sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# --cpu: pin the host platform INSIDE each subprocess. A plain
+# JAX_PLATFORMS=cpu env var does not survive this box's sitecustomize
+# (it force-registers the TPU plugin), so the pin must run as code
+# before the first backend touch — same recipe as conftest.py.
+_CPU_PIN = (
+    "import sys, runpy, jax;"
+    "jax.config.update('jax_platforms','cpu');"
+    "jax.config.update('jax_num_cpu_devices',8);"
+    "sys.argv = sys.argv[1:];"
+    "runpy.run_path(sys.argv[0], run_name='__main__')"
+)
+
+
+def _jobs(quick: bool):
+    q = quick
+    headline_env = (
+        {
+            "BENCH_STEPS": "20",
+            "BENCH_WARMUP": "5",
+            "BENCH_MFU_STEPS": "3",
+            "BENCH_MFU_WARMUP": "1",
+            "BENCH_PROBE_TIMEOUT": "60",
+            "BENCH_INIT_TRIES": "1",
+        }
+        if q
+        else {}
+    )
+    return [
+        ("headline", [sys.executable, "bench.py"], headline_env),
+        (
+            "allreduce_bw",
+            [sys.executable, "benchmarks/allreduce_bw.py"]
+            + (["--max-mb", "1", "--iters", "3", "--warmup", "1"] if q else []),
+            {},
+        ),
+        (
+            "resnet_ddp",
+            [sys.executable, "benchmarks/resnet_ddp.py"]
+            + (["--steps", "5", "--warmup", "2", "--batch", "32"] if q else []),
+            {},
+        ),
+        (
+            "transformer_lm",
+            [sys.executable, "benchmarks/transformer_lm.py"]
+            + (
+                ["--preset", "small", "--steps", "5", "--warmup", "2"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            "bert_finetune",
+            [sys.executable, "benchmarks/bert_finetune.py"]
+            + (
+                ["--preset", "small", "--steps", "5", "--warmup", "2"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            "decode",
+            [sys.executable, "benchmarks/generate_bench.py"]
+            + (
+                ["--preset", "small", "--prompt", "32", "--new", "32"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+    ]
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmarks/results.json")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--timeout", type=float, default=1800.0, help="per bench")
+    ap.add_argument("--only", default=None, help="comma-separated job names")
+    ap.add_argument(
+        "--cpu",
+        action="store_true",
+        help="pin the virtual CPU mesh in each bench (smoke runs / CI)",
+    )
+    args = ap.parse_args()
+
+    jobs = _jobs(args.quick)
+    if args.only:
+        wanted = set(args.only.split(","))
+        unknown = wanted - {n for n, _, _ in jobs}
+        if unknown:
+            ap.error(f"unknown job(s) {sorted(unknown)}; "
+                     f"have {[n for n, _, _ in jobs]}")
+        jobs = [j for j in jobs if j[0] in wanted]
+
+    results = {}
+    for name, argv, env_extra in jobs:
+        env = dict(os.environ, **env_extra)
+        if args.cpu:
+            argv = [sys.executable, "-c", _CPU_PIN] + argv[1:]
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                argv, cwd=ROOT, env=env, capture_output=True, text=True,
+                timeout=args.timeout,
+            )
+            rec = _last_json_line(r.stdout)
+            results[name] = {
+                "rc": r.returncode,
+                "seconds": round(time.time() - t0, 1),
+                "result": rec,
+            }
+            if r.returncode != 0 or rec is None:
+                results[name]["stderr_tail"] = r.stderr[-500:]
+        except subprocess.TimeoutExpired:
+            results[name] = {
+                "rc": -1,
+                "seconds": round(time.time() - t0, 1),
+                "result": None,
+                "error": f"timeout > {args.timeout}s",
+            }
+        status = results[name]
+        print(
+            f"[{name}] rc={status['rc']} {status['seconds']}s "
+            f"{json.dumps(status['result']) if status['result'] else status.get('error', 'NO JSON')}",
+            flush=True,
+        )
+
+    out = os.path.join(ROOT, args.out)
+    with open(out, "w") as f:
+        json.dump(
+            {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"), "results": results},
+            f,
+            indent=2,
+        )
+    print(f"wrote {out}")
+    ok = sum(1 for v in results.values() if v["result"] is not None)
+    print(f"{ok}/{len(results)} benches produced a metric")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

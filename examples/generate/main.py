@@ -46,6 +46,13 @@ def main():
     if args.data:
         data = np.frombuffer(Path(args.data).read_bytes(), dtype=np.uint8)
         vocab = 256
+        need = max(args.seq + 2, args.prompt_len + args.new + 1)
+        if len(data) < need:
+            ap.error(
+                f"--data has {len(data)} bytes; need >= {need} for "
+                f"--seq {args.seq} / --prompt-len {args.prompt_len} "
+                f"--new {args.new}"
+            )
     else:
         # a periodic pattern the model can nail — makes the demo legible
         base = np.arange(16, dtype=np.int32)
@@ -101,8 +108,10 @@ def main():
     acc = float((cont == truth[: len(cont)]).mean()) if not args.data else None
     print("prompt:     ", np.asarray(prompt)[0].tolist())
     print("generated:  ", cont.tolist())
+    print(f"{args.new} tokens in {dt*1e3:.0f} ms "
+          f"({args.new / dt:.1f} tok/s)")
     if acc is not None:
-        print(f"pattern accuracy: {acc:.0%}  ({args.new} tokens in {dt*1e3:.0f} ms)")
+        print(f"pattern accuracy: {acc:.0%}")
 
 
 if __name__ == "__main__":

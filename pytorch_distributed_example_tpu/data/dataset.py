@@ -59,7 +59,26 @@ class ConcatDataset:
         self.datasets = list(datasets)
         if not self.datasets:
             raise ValueError("need at least one dataset")
+        if any(len(d) == 0 for d in self.datasets):
+            raise ValueError("empty source dataset")
         self.cumsizes = np.cumsum([len(d) for d in self.datasets])
+        # validate shapes and fix the promoted dtype per column ONCE, so
+        # batch dtype/shape cannot vary with which sources a batch hits
+        probes = [d[np.asarray([0])] for d in self.datasets]
+        ncols = {len(p) for p in probes}
+        if len(ncols) > 1:
+            raise ValueError(f"column counts differ across datasets: {ncols}")
+        self._col_shapes, self._col_dtypes = [], []
+        for c in range(ncols.pop()):
+            shapes = {np.asarray(p[c]).shape[1:] for p in probes}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"column {c} row shapes differ across datasets: {shapes}"
+                )
+            self._col_shapes.append(shapes.pop())
+            self._col_dtypes.append(
+                np.result_type(*[np.asarray(p[c]).dtype for p in probes])
+            )
 
     def __len__(self) -> int:
         return int(self.cumsizes[-1])
@@ -78,32 +97,23 @@ class ConcatDataset:
             ds, local = self._locate(i + n if i < 0 else i)
             return self.datasets[ds][local]
         idx = np.asarray(idx, dtype=np.intp)
-        if len(idx) == 0:  # empty batch: empty columns, not a crash
-            return self.datasets[0][idx]
-        if ((idx < -n) | (idx >= n)).any():
-            raise IndexError(f"index out of range for size {n}")
-        idx = np.where(idx < 0, idx + n, idx)  # torch-style negatives
+        if len(idx) > 0:
+            if ((idx < -n) | (idx >= n)).any():
+                raise IndexError(f"index out of range for size {n}")
+            idx = np.where(idx < 0, idx + n, idx)  # torch-style negatives
+        # allocate with the construction-time shapes/dtypes: stable
+        # output regardless of which sources this batch touches
+        cols = [
+            np.empty((len(idx),) + s, d)
+            for s, d in zip(self._col_shapes, self._col_dtypes)
+        ]
         which = np.searchsorted(self.cumsizes, idx, side="right")
-        gathered = []  # (positions in the request, that source's rows)
         for ds in np.unique(which):
             sel = np.nonzero(which == ds)[0]
             prev = 0 if ds == 0 else int(self.cumsizes[ds - 1])
-            gathered.append((sel, self.datasets[ds][idx[sel] - prev]))
-        ncols = len(gathered[0][1])
-        cols = []
-        for c in range(ncols):
-            parts = [rows[c] for _, rows in gathered]
-            shapes = {p.shape[1:] for p in parts}
-            if len(shapes) > 1:  # no silent broadcast across sources
-                raise ValueError(
-                    f"column {c} row shapes differ across datasets: {shapes}"
-                )
-            out = np.empty(
-                (len(idx),) + parts[0].shape[1:], np.result_type(*parts)
-            )
-            for (sel, _), p in zip(gathered, parts):
-                out[sel] = p  # one vectorized scatter per source
-            cols.append(out)
+            rows = self.datasets[ds][idx[sel] - prev]
+            for out_col, col in zip(cols, rows):
+                out_col[sel] = col  # one vectorized scatter per source
         return tuple(cols)
 
 

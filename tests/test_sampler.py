@@ -221,13 +221,17 @@ class TestDatasetCombinators:
         bx, _ = cd[np.array([0, 3])]  # one row from each source
         assert bx.dtype == np.float64  # promoted, not silently downcast
         np.testing.assert_array_equal(bx[1], np.full(3, 2.0))
+        # dtype is STABLE: single-source and empty batches promote too
+        assert cd[np.array([3])][0].dtype == np.float64
+        assert cd[np.array([], int)][0].dtype == np.float64
 
-        bad = ConcatDataset(
-            [TensorDataset(np.ones((2, 3)), np.zeros(2)),
-             TensorDataset(np.ones((2, 4)), np.zeros(2))]
-        )
+        # shape mismatch across sources fails at CONSTRUCTION, not when
+        # some unlucky batch happens to straddle the boundary
         with pytest.raises(ValueError, match="shapes differ"):
-            bad[np.array([0, 2])]
+            ConcatDataset(
+                [TensorDataset(np.ones((2, 3)), np.zeros(2)),
+                 TensorDataset(np.ones((2, 4)), np.zeros(2))]
+            )
 
     def test_combinators_feed_the_loader(self):
         from pytorch_distributed_example_tpu.data import (
