@@ -130,6 +130,21 @@ def main():
                      f"have {[n for n, _, _ in jobs]}")
         jobs = [j for j in jobs if j[0] in wanted]
 
+    out_path = os.path.join(ROOT, args.out)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+
+    def flush(results):
+        # rewrite after every job: a late crash/^C keeps finished results
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "results": results,
+                },
+                f,
+                indent=2,
+            )
+
     results = {}
     for name, argv, env_extra in jobs:
         env = dict(os.environ, **env_extra)
@@ -162,15 +177,9 @@ def main():
             f"{json.dumps(status['result']) if status['result'] else status.get('error', 'NO JSON')}",
             flush=True,
         )
+        flush(results)
 
-    out = os.path.join(ROOT, args.out)
-    with open(out, "w") as f:
-        json.dump(
-            {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"), "results": results},
-            f,
-            indent=2,
-        )
-    print(f"wrote {out}")
+    print(f"wrote {out_path}")
     ok = sum(1 for v in results.values() if v["result"] is not None)
     print(f"{ok}/{len(results)} benches produced a metric")
     return 0 if ok else 1

@@ -59,18 +59,20 @@ class ConcatDataset:
         self.datasets = list(datasets)
         if not self.datasets:
             raise ValueError("need at least one dataset")
-        if any(len(d) == 0 for d in self.datasets):
-            raise ValueError("empty source dataset")
         self.cumsizes = np.cumsum([len(d) for d in self.datasets])
+        if self.cumsizes[-1] == 0:
+            raise ValueError("all source datasets are empty")
         # validate shapes and fix the promoted dtype per column ONCE, so
-        # batch dtype/shape cannot vary with which sources a batch hits
-        probes = [d[np.asarray([0])] for d in self.datasets]
+        # batch dtype/shape cannot vary with which sources a batch hits.
+        # Probe one SCALAR row per non-empty source (empty members are
+        # legal — they contribute no rows — and lazy sources pay one read)
+        probes = [d[0] for d in self.datasets if len(d) > 0]
         ncols = {len(p) for p in probes}
         if len(ncols) > 1:
             raise ValueError(f"column counts differ across datasets: {ncols}")
         self._col_shapes, self._col_dtypes = [], []
         for c in range(ncols.pop()):
-            shapes = {np.asarray(p[c]).shape[1:] for p in probes}
+            shapes = {np.asarray(p[c]).shape for p in probes}
             if len(shapes) > 1:
                 raise ValueError(
                     f"column {c} row shapes differ across datasets: {shapes}"

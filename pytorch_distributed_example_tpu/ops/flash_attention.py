@@ -306,20 +306,30 @@ def flash_attention(
     v,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Flash attention over (B, L, H, D) tensors; differentiable.
+
+    Block sizes default to 128 (one MXU tile) and can be overridden per
+    call or fleet-wide via `TDX_FLASH_BLOCK_Q` / `TDX_FLASH_BLOCK_K` —
+    `benchmarks/flash_bench.py` sweeps them on real hardware.
 
     Constraints: L divisible by block sizes (pad upstream); K/V for one
     head must fit VMEM (L·D·4 bytes ≤ ~4 MB ⇒ L ≤ 8k at D=128) — the
     streaming-HBM variant for longer L is ring attention over the mesh
     (parallel/context_parallel.py), which calls this kernel per shard.
     """
+    import os
+
     B, L, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if block_q is None:
+        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 128))
+    if block_k is None:
+        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 128))
     bq, bk = min(block_q, L), min(block_k, L)
     if L % bq or L % bk:
         raise ValueError(f"seq len {L} must be divisible by block sizes ({bq},{bk})")

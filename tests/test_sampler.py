@@ -233,6 +233,30 @@ class TestDatasetCombinators:
                  TensorDataset(np.ones((2, 4)), np.zeros(2))]
             )
 
+    def test_concat_allows_empty_members_and_scalar_sources(self):
+        from pytorch_distributed_example_tpu.data import (
+            ConcatDataset,
+            Subset,
+            TensorDataset,
+        )
+
+        ds = TensorDataset(np.arange(8).reshape(4, 2), np.arange(4))
+        cd = ConcatDataset([ds, Subset(ds, [])])  # empty member: legal
+        assert len(cd) == 4
+        np.testing.assert_array_equal(cd[np.array([3, 0])][1], [3, 0])
+
+        class ScalarOnly:  # sources need only scalar __getitem__
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return np.full(2, float(i)), np.int64(i)
+
+        mixed = ConcatDataset([ScalarOnly(), ScalarOnly()])
+        assert len(mixed) == 6
+        _, y = mixed[4]
+        assert y == 1
+
     def test_combinators_feed_the_loader(self):
         from pytorch_distributed_example_tpu.data import (
             ConcatDataset,
