@@ -25,7 +25,14 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-OPS = ["all_reduce", "broadcast", "scatter", "all_gather", "reduce_scatter"]
+OPS = [
+    "all_reduce",
+    "broadcast",
+    "scatter",
+    "all_gather",
+    "reduce_scatter",
+    "send_recv",
+]
 
 
 def main():
@@ -47,8 +54,8 @@ def main():
         tdx.init_process_group(backend="xla")
     W = tdx.get_world_size()
 
-    if args.op == "both":
-        ops = ["all_reduce", "broadcast"]
+    if args.op == "both":  # headline trio: reduce, one-to-all, p2p
+        ops = ["all_reduce", "broadcast", "send_recv"]
     elif args.op == "all":
         ops = OPS
     else:
@@ -80,6 +87,26 @@ def main():
             elif op == "all_gather":
                 run = lambda: tdx.all_gather(flat)
                 bus_factor = (W - 1) / W
+            elif op == "send_recv":
+                # p2p data plane (round-2 VERDICT #5): a full ring of
+                # paired send/recv — ONE lax.ppermute over the mesh, the
+                # device-to-device route for same-mesh transfers. Every
+                # rank ships the whole payload one hop, so algbw is
+                # directly comparable to broadcast's.
+                def run():
+                    ops = []
+                    for r in range(W):
+                        ops.append(
+                            tdx.P2POp(tdx.isend, flat, (r + 1) % W, rank=r)
+                        )
+                        ops.append(
+                            tdx.P2POp(tdx.irecv, flat, (r - 1) % W, rank=r)
+                        )
+                    for w in tdx.batch_isend_irecv(ops):
+                        w.wait()
+                    return flat
+
+                bus_factor = 1.0
             else:  # reduce_scatter
                 run = lambda: tdx.reduce_scatter(rows)
                 bus_factor = (W - 1) / W
@@ -94,7 +121,11 @@ def main():
                 out = run()
             out.block_until_ready()
             dt = (time.perf_counter() - t0) / args.iters
-            payload = size if op in ("all_reduce", "broadcast", "all_gather") else nc * W * 4
+            payload = (
+                size
+                if op in ("all_reduce", "broadcast", "all_gather", "send_recv")
+                else nc * W * 4
+            )
             algbw = payload / dt / 1e9
             results.append(
                 emit(

@@ -1,0 +1,323 @@
+"""Flagship-scale perf point — BASELINE.json configs[4], round-2 VERDICT #3.
+
+Two honestly-scoped modes (8B does not fit one v5e chip):
+
+* ``--mode mfu``: the largest-that-fits (~1B param, bf16) TransformerLM
+  single-chip MFU bench — full train step (fwd+bwd+adamw), per-block
+  remat, flash attention. TPU only (emits a skip record elsewhere).
+* ``--mode memory8b``: the TRUE Llama-3-8B FSDP-full-shard (ZeRO-3)
+  GSPMD layout, AOT-lowered and compiled over an 8-device mesh — no
+  execution — reporting XLA's per-device memory analysis, proving the
+  8B layout fits a v4-8-class slice. Runs on the virtual CPU mesh.
+
+Llama-3-8B geometry (public model card): d=4096, 32 layers, 32 heads,
+8 KV heads (GQA), ffn 14336, vocab 128256, seq 4096 (the 8192-native
+model benched at 4k ctx, matching torch FSDP recipes).
+
+Usage:
+    python benchmarks/llama_scaled.py --mode memory8b      # any host
+    python benchmarks/llama_scaled.py --mode mfu           # TPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# ~1B bf16 config that fits one 16 GB chip with bf16 optimizer state +
+# per-block remat: params ~0.94 GB*2B, grads 2B, adamw m+v 4B -> ~7.5 GB.
+CFG_1B = dict(
+    vocab_size=32000,
+    d_model=2048,
+    n_layers=16,
+    n_heads=16,
+    d_ff=5504,
+)
+CFG_8B = dict(
+    vocab_size=128256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+)
+
+
+def _build(cfg_kw, seq, bf16_params, use_flash):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_example_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+        use_flash=use_flash,
+        remat=True,
+        **cfg_kw,
+    )
+    model = TransformerLM(cfg)
+    return model, cfg
+
+
+def _n_params(tree):
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def _analytic_flops(n_params, n_layers, d_model, seq, tokens):
+    # PaLM appendix-B convention, as in bench.py: 6N (fwd+bwd matmuls)
+    # + 12*l*d*L attention term, per token.
+    return (6.0 * n_params + 12.0 * n_layers * d_model * seq) * tokens
+
+
+def run_mfu(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from benchmarks.common import emit
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    if dev.platform.lower() not in ("tpu", "axon") and "tpu" not in kind.lower():
+        emit(
+            "llama_scaled_mfu",
+            0.0,
+            "mfu",
+            skipped="requires TPU (single-chip HBM-resident 1B model)",
+            platform=dev.platform,
+        )
+        return
+
+    from bench import _peak_flops  # spec-sheet bf16 peaks
+
+    peak = _peak_flops(kind)
+    B, L = args.batch, args.seq
+    model, cfg = _build(CFG_1B, L, True, use_flash=not args.no_flash)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, L)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), toks)
+    # bf16 master weights + bf16 adamw state: the fit-on-one-chip layout
+    params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
+    n_params = _n_params(params)
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, toks):
+        def lf(p):
+            logits = model.apply(p, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), toks[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    params, opt_state, loss = step(params, opt_state, toks)  # compile
+    jax.block_until_ready(loss)
+    for _ in range(args.warmup):
+        params, opt_state, loss = step(params, opt_state, toks)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, toks)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)
+    mfu = flops / dt / peak if peak else 0.0
+    emit(
+        "llama_scaled_mfu",
+        round(mfu, 4),
+        "mfu",
+        n_params=n_params,
+        tflops=round(flops / dt / 1e12, 2),
+        tokens_per_sec=round(B * L / dt, 1),
+        step_ms=round(dt * 1e3, 1),
+        batch=B,
+        seq=L,
+        device_kind=kind,
+    )
+
+
+def run_memory8b(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from benchmarks.common import emit
+    from pytorch_distributed_example_tpu.models.transformer import sharding_rules
+    from pytorch_distributed_example_tpu.parallel import sharding as shd
+    from pytorch_distributed_example_tpu.parallel.fsdp import make_fsdp_train_step
+
+    import optax
+
+    n_dev = len(jax.devices())
+    fsdp = args.fsdp or n_dev // args.tp
+    devs = np.array(jax.devices()[: fsdp * args.tp]).reshape(fsdp, args.tp)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+
+    model, cfg = _build(CFG_8B, args.seq, True, use_flash=False)
+    toks_abs = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32)
+    abs_params = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, args.seq), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )
+    abs_params = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), abs_params
+    )
+    n_params = _n_params(abs_params)
+    rules = sharding_rules(tp_axis="tp", fsdp_axis="fsdp")
+    specs = shd.make_param_specs(abs_params, rules, mesh)
+    opt = optax.adamw(1e-4)
+    abs_opt = jax.eval_shape(opt.init, abs_params)
+
+    step = make_fsdp_train_step(
+        model.apply,
+        lambda lg, y: optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1].astype(jnp.float32), y[:, 1:]
+        ).mean(),
+        opt,
+        mesh,
+        specs,
+        data_axes=("fsdp",),
+        remat=False,  # cfg.remat already checkpoints per block
+        donate=True,
+    )
+    # place abstract leaves on their shardings so AOT lowering sees the
+    # true FSDP layout
+    abs_params = jax.tree_util.tree_map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)
+        ),
+        abs_params,
+        specs,
+    )
+    t0 = time.perf_counter()
+    lowered = step.lower(abs_params, abs_opt, toks_abs, toks_abs)
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+
+    # XLA's own accounting, no execution (VERDICT #3's requested evidence)
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        for f in (
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+            "generated_code_size_in_bytes",
+            "alias_size_in_bytes",
+        ):
+            v = getattr(ma, f, None)
+            if v is not None:
+                mem[f] = int(v)
+    except Exception as e:
+        mem["memory_analysis_error"] = repr(e)
+
+    # Analytic per-device table from the specs (cross-check / fallback):
+    # bf16 params+grads+adamw m+v (optax states inherit param dtype),
+    # all sharded per the layout.
+    axis_sizes = dict(mesh.shape)
+
+    def shard_bytes(leaf, spec):
+        denom = 1
+        for ax in spec:
+            if ax is None:
+                continue
+            for a in ax if isinstance(ax, tuple) else (ax,):
+                denom *= axis_sizes[a]
+        return leaf.size * leaf.dtype.itemsize // denom
+
+    p_bytes = sum(
+        shard_bytes(l, s)
+        for l, s in zip(
+            jax.tree_util.tree_leaves(abs_params), jax.tree_util.tree_leaves(specs)
+        )
+    )
+    analytic = {
+        "params_bytes_per_device": p_bytes,
+        "grads_bytes_per_device": p_bytes,
+        "adamw_state_bytes_per_device": 2 * p_bytes,  # m+v in param dtype
+        "total_state_bytes_per_device": 4 * p_bytes,
+    }
+    # STATE memory is the XLA-verified figure: the executable's per-device
+    # argument bytes are params + opt state as actually sharded (donated
+    # args alias outputs, so they count once); grads live in the same
+    # layout, one extra params-worth of temp.
+    state_per_dev = mem.get("argument_size_in_bytes", 3 * p_bytes) + p_bytes
+    # Activation peak for the TPU path (flash + per-block remat; the
+    # CPU backend's temp accounting does NOT honor the remat schedule —
+    # probed: temp identical with remat on/off even though the jaxpr
+    # carries one remat eqn per block — and uses dense attention, so its
+    # temp number is reported raw but does not transfer to TPU):
+    # block-input stash (n_layers x B_loc x L x d x 2B) + one block's
+    # recompute workspace + the fp32 logit/dlogit slices.
+    b_loc = max(args.batch // fsdp, 1)
+    act = (
+        cfg.n_layers * b_loc * args.seq * cfg.d_model * 2  # stashed block inputs
+        + 4 * b_loc * args.seq * cfg.d_model * 2 * 6  # one block live (qkv/ffn)
+        + 2 * b_loc * args.seq * cfg.vocab_size * 4 // max(args.tp, 1)
+    )
+    total = state_per_dev + act
+    emit(
+        "llama_scaled_memory8b",
+        round(total / 1e9, 3),
+        "GB/device",
+        n_params=n_params,
+        mesh={"fsdp": fsdp, "tp": args.tp},
+        seq=args.seq,
+        batch=args.batch,
+        compile_s=round(compile_s, 1),
+        state_bytes_per_device_xla_verified=int(state_per_dev),
+        activation_bytes_per_device_analytic=int(act),
+        xla_memory_analysis=mem,
+        cpu_temp_caveat=(
+            "temp_size is the CPU backend's schedule (dense attention, "
+            "remat not honored by its buffer liveness); TPU uses "
+            "flash+remat — see activation_bytes_per_device_analytic"
+        ),
+        analytic=analytic,
+        fits_16gb_hbm=bool(total < 16e9),  # v5e/v5 lite class
+        fits_32gb_hbm=bool(total < 32e9),  # v4-8 class (32 GB/chip)
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["mfu", "memory8b"], default="memory8b")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--no-flash", action="store_true")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--fsdp", type=int, default=None)
+    args = ap.parse_args()
+    if args.mode == "mfu":
+        args.batch = args.batch or 8
+        args.seq = args.seq or 1024
+        run_mfu(args)
+    else:
+        args.batch = args.batch or 8
+        args.seq = args.seq or 4096
+        run_memory8b(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,10 +75,24 @@ class AsyncSaveHandle:
         self._ckptr = checkpointer
         self.path = path
         self._closed = False
-        self._waiter = threading.Thread(
-            target=checkpointer.wait_until_finished, daemon=True
-        )
+        self._close_lock = threading.Lock()
+        # close in the waiter itself: fire-and-forget callers (poll
+        # done() / never join) must not leak the checkpointer's
+        # background threads per save
+        self._waiter = threading.Thread(target=self._wait_and_close, daemon=True)
         self._waiter.start()
+
+    def _wait_and_close(self):
+        try:
+            self._ckptr.wait_until_finished()
+        finally:
+            self._close()
+
+    def _close(self):
+        with self._close_lock:
+            if not self._closed:
+                self._ckptr.close()
+                self._closed = True
 
     def result(self, timeout: Optional[float] = None) -> str:
         """Block until the write is durable; returns the directory."""
@@ -88,9 +102,7 @@ class AsyncSaveHandle:
                 f"checkpoint write to {self.path} still in flight after "
                 f"{timeout}s"
             )
-        if not self._closed:
-            self._ckptr.close()
-            self._closed = True
+        self._close()
         return self.path
 
     # Future-protocol aliases

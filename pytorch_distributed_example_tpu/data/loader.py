@@ -81,21 +81,28 @@ class DataLoader:
         from concurrent.futures import ThreadPoolExecutor
 
         depth = self.num_workers * self.prefetch_factor
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            pending = deque()
-            batch_iter = self._batches(indices)
-            try:
-                for idx in batch_iter:
-                    pending.append(pool.submit(self._fetch, idx))
-                    # only drain past the depth so a full `depth` batches
-                    # stay in flight WHILE the consumer runs its step
-                    if len(pending) > depth:
-                        yield pending.popleft().result()
-                while pending:
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        pending = deque()
+        batch_iter = self._batches(indices)
+        try:
+            for idx in batch_iter:
+                pending.append(pool.submit(self._fetch, idx))
+                # drain only past the depth so `depth` fetches remain
+                # queued WHILE the consumer runs its step (at depth=1 a
+                # `>=` drain would serialize fetch and consume entirely).
+                # The transient depth+1 queue entry is a COMPLETED batch
+                # buffer, not an extra concurrent fetch — concurrency is
+                # capped by the pool's num_workers either way.
+                if len(pending) > depth:
                     yield pending.popleft().result()
-            finally:
-                for f in pending:  # consumer bailed early / fetch raised
-                    f.cancel()
+            while pending:
+                yield pending.popleft().result()
+            pool.shutdown(wait=True)
+        except BaseException:
+            # consumer bailed early / fetch raised: drop queued work and
+            # do NOT block on in-flight fetches finishing
+            pool.shutdown(wait=False, cancel_futures=True)
+            raise
 
     def __len__(self) -> int:
         n = len(self.sampler) if self.sampler is not None else len(self.dataset)

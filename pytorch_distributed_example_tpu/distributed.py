@@ -28,6 +28,7 @@ import logging
 import os
 import pickle
 import sys
+import time
 from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -1143,6 +1144,19 @@ def _p2p_counters(g: ProcessGroup, which: str) -> Dict:
     return ctr
 
 
+# Large p2p payloads are split into bounded chunks streamed through the
+# daemon (round-2 VERDICT #5: the single-daemon funnel must not buffer a
+# whole tensor in one message). The manifest key is written FIRST so the
+# receiver drains chunk i while the sender is still writing chunk i+1 —
+# sender/receiver pipelining through the store, the moral equivalent of
+# gloo's chunked TCP streams (ProcessGroupGloo.hpp p2p ops).
+_P2P_CHUNK_MAGIC = b"TDXCHUNKS:"
+
+
+def _p2p_chunk_bytes() -> int:
+    return int(os.environ.get("TDX_P2P_CHUNK_BYTES", str(4 << 20)))
+
+
 def _store_send(tensor, dst: int, g: ProcessGroup, tag: int) -> None:
     """Multiproc send: serialize this process's tensor into the store under
     a generation- and group-scoped per-(dst, tag) sequence key — the
@@ -1154,7 +1168,17 @@ def _store_send(tensor, dst: int, g: ProcessGroup, tag: int) -> None:
     seq = ctr.get((dst, tag), 0)
     ctr[(dst, tag)] = seq + 1
     val = np.asarray(tensor.local_numpy()[0] if isinstance(tensor, DistTensor) else tensor)
-    g.store.set(_p2p_key(_world.scope, me, dst, tag, seq), pickle.dumps(val))
+    key = _p2p_key(_world.scope, me, dst, tag, seq)
+    payload = pickle.dumps(val)
+    chunk = _p2p_chunk_bytes()
+    if len(payload) <= chunk:
+        g.store.set(key, payload)
+        return
+    n = (len(payload) + chunk - 1) // chunk
+    # manifest first: the receiver starts draining immediately
+    g.store.set(key, _P2P_CHUNK_MAGIC + pickle.dumps((n, len(payload))))
+    for i in range(n):
+        g.store.set(f"{key}/c{i}", payload[i * chunk : (i + 1) * chunk])
 
 
 def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
@@ -1164,7 +1188,23 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
     ctr[(src, tag)] = seq + 1
     key = _p2p_key(_world.scope, src, me, tag, seq)
     g.store.wait([key], timeout)
-    val = pickle.loads(g.store.get(key))
+    head = g.store.get(key)
+    if head.startswith(_P2P_CHUNK_MAGIC):
+        n, total = pickle.loads(head[len(_P2P_CHUNK_MAGIC):])
+        parts = []
+        for i in range(n):  # chunks stream in-order behind the manifest
+            ck = f"{key}/c{i}"
+            g.store.wait([ck], timeout)
+            parts.append(g.store.get(ck))
+            try:
+                g.store.delete_key(ck)
+            except Exception:
+                pass
+        payload = b"".join(parts)
+        assert len(payload) == total, (len(payload), total)
+        val = pickle.loads(payload)
+    else:
+        val = pickle.loads(head)
     try:
         g.store.delete_key(key)
     except Exception:
@@ -1174,13 +1214,45 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
     return val
 
 
-class _StoreRecvWork(Work):
-    """Deferred multiproc receive: `wait()` performs the blocking read."""
+def _store_recv_any(tensor, g: ProcessGroup, tag: int, timeout: float):
+    """Any-source receive (torch `recv(src=None)`,
+    `distributed_c10d.py:2682-2750`): poll every peer's next-expected
+    sequence key until one is present, then do the normal receive from
+    that peer. Returns (src, value)."""
+    me = g.rank()
+    ctr = _p2p_counters(g, "recv")
+    peers = [r for r in range(g.size()) if r != me]
+    budget = timeout if timeout is not None else 3600.0
+    deadline = time.monotonic() + budget
+    poll = 0.002
+    while True:
+        for src in peers:
+            seq = ctr.get((src, tag), 0)
+            key = _p2p_key(_world.scope, src, me, tag, seq)
+            # a store failure here is a real error (dead daemon), not
+            # "key absent" — let it propagate instead of spinning on it
+            if g.store.check([key]):
+                return src, _store_recv(tensor, src, g, tag, timeout)
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"recv(src=None): no sender within {budget}s (tag={tag})"
+            )
+        # exponential backoff to 50 ms: a long any-source wait must not
+        # hammer the single-threaded daemon with W RPCs every 2 ms
+        time.sleep(poll)
+        poll = min(poll * 2, 0.05)
 
-    def __init__(self, tensor, src: int, g: ProcessGroup, tag: int):
+
+class _StoreRecvWork(Work):
+    """Deferred multiproc receive: `wait()` performs the blocking read.
+    `src=None` resolves any-source at wait time; `source_rank()` then
+    reports who sent (torch `Work._source_rank`)."""
+
+    def __init__(self, tensor, src: Optional[int], g: ProcessGroup, tag: int):
         super().__init__(OpType.RECV, "store:recv")
         self._args = (tensor, src, g, tag)
         self._done = False
+        self._src = src
         self.value = None
 
     def is_completed(self) -> bool:
@@ -1189,9 +1261,17 @@ class _StoreRecvWork(Work):
     def wait(self, timeout: Optional[float] = None) -> bool:
         if not self._done:
             t, src, g, tag = self._args
-            self.value = _store_recv(t, src, g, tag, timeout or g.timeout)
+            if src is None:
+                self._src, self.value = _store_recv_any(
+                    t, g, tag, timeout or g.timeout
+                )
+            else:
+                self.value = _store_recv(t, src, g, tag, timeout or g.timeout)
             self._done = True
         return True
+
+    def source_rank(self) -> Optional[int]:
+        return self._src
 
     def result(self):
         return self.value
@@ -1229,7 +1309,8 @@ def recv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Op
     g = _resolve(group)
     if _world.mode == "multiproc":
         if src is None:
-            raise ValueError("multiproc recv: src=None (any-source) unsupported; pass src")
+            src, recv.last_value = _store_recv_any(tensor, g, tag, g.timeout)
+            return src
         recv.last_value = _store_recv(tensor, src, g, tag, g.timeout)
         return src
     return src if src is not None else -1
@@ -1253,8 +1334,6 @@ def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = No
 def irecv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Optional[int] = None) -> Work:
     g = _resolve(group)
     if _world.mode == "multiproc":
-        if src is None:
-            raise ValueError("multiproc irecv: src=None unsupported; pass src")
         return _StoreRecvWork(tensor, src, g, tag)
     return CompletedWork(tensor, OpType.RECV)
 

@@ -255,7 +255,17 @@ class DTensor:
             return self._array  # the pending stack IS the local view
         if all(isinstance(p, Replicate) for p in self._placements):
             return self._array
-        return [s.data for s in self._array.addressable_shards]
+        # addressable_shards ordering is NOT guaranteed to be mesh order;
+        # sort by the device's position in the mesh's flat device list so
+        # the promise above ("keyed by flat device order") holds
+        order = {
+            d.id: i for i, d in enumerate(self._mesh.devices.flat)
+        }
+        shards = sorted(
+            self._array.addressable_shards,
+            key=lambda s: order.get(s.device.id, len(order)),
+        )
+        return [s.data for s in shards]
 
     def full_tensor(self):
         """Replicated global value (torch `full_tensor`): redistribute all

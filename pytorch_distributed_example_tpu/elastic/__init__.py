@@ -1,1 +1,6 @@
-from .agent import LocalElasticAgent, WorkerSpec, WorkerState  # noqa: F401
+from .agent import (  # noqa: F401
+    LocalElasticAgent,
+    WorkerSpec,
+    WorkerState,
+    request_join,
+)

@@ -33,6 +33,10 @@ class WorkerState(enum.Enum):
     HEALTHY = "HEALTHY"
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
+    # agent-internal: a joiner is waiting and the gang has headroom —
+    # re-form at the next generation boundary (torchelastic's
+    # num_nodes_waiting poll, elastic/agent/server/api.py:952-970)
+    SCALE_UP = "SCALE_UP"
 
 
 @dataclass
@@ -50,7 +54,31 @@ class WorkerSpec:
     nnodes: int = 1  # torchrun --nnodes
     node_rank: int = 0  # torchrun --node-rank; node 0 hosts the store
     peer_done_timeout_s: float = 600.0  # max finish-time skew across nodes
+    # Dynamic world size (torchrun --nnodes=MIN:MAX semantics,
+    # run.py:410): when set, the local worker group is ELASTIC —
+    # `nproc_per_node` is the MAX size; a worker failure re-forms the
+    # gang at the surviving size as long as it stays >= min_nproc, and
+    # late joiners (`request_join`) are admitted at the next generation
+    # boundary up to the max. Single-node only (the elastic unit here is
+    # the local worker; multi-node gangs stay fixed-size).
+    min_nproc: Optional[int] = None
     env: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.min_nproc is not None:
+            if self.nnodes != 1:
+                raise ValueError(
+                    "elastic worker range (min_nproc) is single-node only"
+                )
+            if not 1 <= self.min_nproc <= self.nproc_per_node:
+                raise ValueError(
+                    f"min_nproc {self.min_nproc} must be in "
+                    f"[1, nproc_per_node={self.nproc_per_node}]"
+                )
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_nproc is not None
 
     @property
     def world_size(self) -> int:
@@ -71,6 +99,31 @@ class RunResult:
     return_codes: Dict[int, int]
 
 
+_JOIN_KEY = "agent/join_waiting"  # NOT generation-namespaced: must survive re-forms
+
+
+def request_join(master_addr: str, master_port: int, timeout: float = 30.0) -> int:
+    """Ask a running elastic agent to admit one more worker at its next
+    generation boundary (torchelastic: a new node entering the dynamic
+    rendezvous, elastic/agent/server/api.py:952-970). Returns the number
+    of joiners now waiting (including this one).
+
+    The endpoint is the agent's store: `agent.join_endpoint`, also
+    announced on stderr at elastic start (ephemeral-port standalone runs
+    bind an OS-assigned port, so the spec's port 0 is NOT connectable)."""
+    if master_port <= 0:
+        raise ValueError(
+            "request_join needs the agent's BOUND store port (spec port 0 "
+            "is ephemeral) — read agent.join_endpoint or the 'elastic "
+            "join endpoint' line the agent prints at start"
+        )
+    s = TCPStore(master_addr, master_port, is_master=False, timeout=timeout)
+    try:
+        return s.add(_JOIN_KEY, 1)
+    finally:
+        s.close()
+
+
 class LocalElasticAgent:
     def __init__(self, spec: WorkerSpec, log_dir: Optional[str] = None):
         self.spec = spec
@@ -79,6 +132,14 @@ class LocalElasticAgent:
         self._ctrl: Optional[TCPStore] = None
         self._workers: List[_Worker] = []
         self.restart_count = 0
+        # elastic mode: current gang size (<= spec.nproc_per_node) and the
+        # failure budget, tracked separately so join admissions don't
+        # consume max_restarts
+        self.active_nproc = spec.nproc_per_node
+        self._failure_restarts = 0
+        # (host, bound_port) of the store once hosting starts — the
+        # address request_join callers need (standalone specs say port 0)
+        self.join_endpoint: Optional[tuple] = None
 
     # -- store hosting -----------------------------------------------------
     def _ensure_store(self) -> Optional[TCPStore]:
@@ -143,6 +204,15 @@ class LocalElasticAgent:
     def _start_workers(self) -> None:
         store = self._ensure_store()
         port = store.port if store is not None else self.spec.master_port
+        if self.spec.elastic and self.join_endpoint is None:
+            # announce the BOUND port: standalone runs use port 0 in the
+            # spec, which request_join callers cannot connect to
+            self.join_endpoint = (self.spec.master_addr, port)
+            print(
+                f"tpurun: elastic join endpoint "
+                f"{self.spec.master_addr}:{port}",
+                file=sys.stderr,
+            )
         # jax coordinator port: single-node picks a fresh free port per
         # generation (store_port+1 may be held by an unrelated process);
         # multi-node keeps the store_port+1 convention because every node
@@ -153,16 +223,20 @@ class LocalElasticAgent:
         else:
             jax_port = port + 1
         self._workers = []
-        for r in range(self.spec.nproc_per_node):
-            global_rank = self.spec.node_rank * self.spec.nproc_per_node + r
+        # elastic gangs spawn the CURRENT size (shrunk/grown across
+        # generations); fixed-size gangs always spawn the spec size
+        nproc = self.active_nproc if self.spec.elastic else self.spec.nproc_per_node
+        world = nproc if self.spec.elastic else self.spec.world_size
+        for r in range(nproc):
+            global_rank = self.spec.node_rank * nproc + r
             env = {
                 **os.environ,
                 **self.spec.env,
                 "RANK": str(global_rank),
                 "LOCAL_RANK": str(r),
                 "GROUP_RANK": str(self.spec.node_rank),
-                "LOCAL_WORLD_SIZE": str(self.spec.nproc_per_node),
-                "WORLD_SIZE": str(self.spec.world_size),
+                "LOCAL_WORLD_SIZE": str(nproc),
+                "WORLD_SIZE": str(world),
                 "MASTER_ADDR": self.spec.master_addr,
                 "MASTER_PORT": str(port),
                 "TDX_RESTART_COUNT": str(self.restart_count),
@@ -223,6 +297,12 @@ class LocalElasticAgent:
             time.sleep(self.spec.monitor_interval_s)
             codes = {w.local_rank: w.proc.poll() for w in self._workers}
             if any(c is not None and c != 0 for c in codes.values()):
+                # elastic shrink needs the count of PERMANENTLY lost
+                # workers (exited nonzero / killed) at observation time —
+                # the rest are healthy and only torn down for re-rendezvous
+                self._observed_failed = sum(
+                    1 for c in codes.values() if c is not None and c != 0
+                )
                 if ctrl is not None:
                     try:
                         ctrl.set("agent/restart_gen", str(self.restart_count + 1))
@@ -231,12 +311,45 @@ class LocalElasticAgent:
                 return WorkerState.FAILED
             if all(c == 0 for c in codes.values()):
                 return WorkerState.SUCCEEDED
+            if (
+                self.spec.elastic
+                and self.active_nproc < self.spec.nproc_per_node
+                and self._join_waiting() > 0
+            ):
+                return WorkerState.SCALE_UP
             if ctrl is not None:
                 g = self._peek(ctrl, "agent/restart_gen")
                 if g is not None and int(g) > self.restart_count:
                     return WorkerState.FAILED  # peer-signaled restart
                 if self._peek(ctrl, "agent/fatal") is not None:
                     return WorkerState.FAILED
+
+    def _join_waiting(self) -> int:
+        """How many joiners are queued on the store (add(0) = atomic read)."""
+        store = self._ensure_store()
+        if store is None:
+            return 0
+        try:
+            return store.add(_JOIN_KEY, 0)
+        except Exception:
+            return 0
+
+    def _admit_joiners(self, survivors: int) -> int:
+        """Consume queued join requests up to the spec max; returns the
+        new gang size. Decrements the counter only by what was admitted —
+        joiners beyond max stay queued for a later generation."""
+        store = self._ensure_store()
+        if store is None:
+            return survivors
+        try:
+            waiting = store.add(_JOIN_KEY, 0)
+            new = min(survivors + waiting, self.spec.nproc_per_node)
+            admitted = new - survivors
+            if admitted:
+                store.add(_JOIN_KEY, -admitted)
+            return new
+        except Exception:
+            return survivors
 
     def _await_peers_done(self) -> str:
         """Multi-node success path: a node whose workers exited 0 must not
@@ -342,8 +455,44 @@ class LocalElasticAgent:
                         )
                     # "restart": a peer failed after our success — rejoin
                     # the gang for the next generation
+                if state is WorkerState.SCALE_UP:
+                    # generation boundary for a join: healthy workers are
+                    # re-rendezvoused at the grown size (torchelastic
+                    # restarts the worker group when a node joins)
+                    self._stop_workers()
+                    self.active_nproc = self._admit_joiners(self.active_nproc)
+                    self.restart_count += 1
+                    self._start_workers()
+                    continue
                 # failure: tear down the whole gang and re-rendezvous
+                n_failed = getattr(self, "_observed_failed", 1)
                 self._stop_workers()
+                if self.spec.elastic:
+                    if self._failure_restarts >= self.spec.max_restarts:
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    # --nnodes=MIN:MAX semantics: re-form at the surviving
+                    # size (plus any queued joiners); below MIN the gang
+                    # cannot meet quorum and the job fails
+                    survivors = max(self.active_nproc - n_failed, 0)
+                    new_size = self._admit_joiners(survivors)
+                    if new_size < (self.spec.min_nproc or 1):
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            {w.local_rank: w.proc.returncode for w in self._workers},
+                        )
+                    self._failure_restarts += 1
+                    self.restart_count += 1
+                    self.active_nproc = new_size
+                    # the store stays up across generations: its endpoint
+                    # must remain stable for request_join callers; workers
+                    # namespace their keys by TDX_RESTART_COUNT
+                    self._start_workers()
+                    continue
                 if self.spec.nnodes > 1:
                     if not self._restart_barrier():
                         return RunResult(

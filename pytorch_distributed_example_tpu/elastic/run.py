@@ -22,11 +22,28 @@ import sys
 from .agent import LocalElasticAgent, WorkerSpec, WorkerState
 
 
+def _size_range(val: str):
+    """torchrun size syntax: "N" (fixed) or "MIN:MAX" (elastic,
+    torch/distributed/run.py:410). Returns (min, max)."""
+    if ":" in val:
+        lo, _, hi = val.partition(":")
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(val)
+    if not 1 <= lo <= hi:
+        raise argparse.ArgumentTypeError(f"bad size range {val!r}")
+    return lo, hi
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(prog="tpurun")
-    p.add_argument("--nproc-per-node", type=int, default=1)
-    p.add_argument("--nnodes", type=int, default=1,
-                   help="number of nodes (torchrun --nnodes)")
+    p.add_argument("--nproc-per-node", type=_size_range, default=(1, 1),
+                   help="workers per node; MIN:MAX makes the local worker "
+                        "group elastic (dynamic world size)")
+    p.add_argument("--nnodes", type=_size_range, default=(1, 1),
+                   help="number of nodes (torchrun --nnodes); MIN:MAX is "
+                        "elastic — single-agent deployments map the node "
+                        "range onto the local worker group")
     p.add_argument("--node-rank", type=int, default=0,
                    help="this node's rank; node 0 hosts the rendezvous store")
     p.add_argument("--max-restarts", type=int, default=3)
@@ -57,7 +74,7 @@ def main(argv=None) -> int:
         return 2
     master_addr, master_port = args.master_addr, args.master_port
     if args.standalone:
-        args.nnodes, args.node_rank = 1, 0
+        args.nnodes, args.node_rank = (1, 1), 0
         master_addr, master_port = "127.0.0.1", 0
     elif args.rdzv_endpoint:
         if ":" in args.rdzv_endpoint:
@@ -73,18 +90,46 @@ def main(argv=None) -> int:
                 return 2
         else:
             master_addr, master_port = args.rdzv_endpoint, 29500
-    spec = WorkerSpec(
-        entrypoint=args.entrypoint,
-        nproc_per_node=args.nproc_per_node,
-        nnodes=args.nnodes,
-        node_rank=args.node_rank,
-        max_restarts=args.max_restarts,
-        monitor_interval_s=args.monitor_interval,
-        master_addr=master_addr,
-        master_port=master_port,
-        raw_cmd=args.no_python,
-        module=args.module,
-    )
+    min_proc, max_proc = args.nproc_per_node
+    min_nodes, max_nodes = args.nnodes
+    if min_nodes != max_nodes and min_proc != max_proc:
+        print(
+            "tpurun: give an elastic range on --nnodes OR "
+            "--nproc-per-node, not both (the combined minimum would be "
+            "ambiguous)",
+            file=sys.stderr,
+        )
+        return 2
+    if min_nodes != max_nodes:
+        # elastic NODE range: a single local agent hosts the whole gang,
+        # so the node range maps onto the worker-group range (the gang
+        # scales between min_nodes*nproc and max_nodes*nproc workers)
+        if args.node_rank != 0:
+            print(
+                "tpurun: --nnodes MIN:MAX requires a single agent "
+                "(node-rank 0) hosting the elastic worker group",
+                file=sys.stderr,
+            )
+            return 2
+        min_proc, max_proc = min_nodes * max_proc, max_nodes * max_proc
+        min_nodes = max_nodes = 1
+    try:
+        spec = WorkerSpec(
+            entrypoint=args.entrypoint,
+            nproc_per_node=max_proc,
+            min_nproc=min_proc if min_proc != max_proc else None,
+            nnodes=max_nodes,
+            node_rank=args.node_rank,
+            max_restarts=args.max_restarts,
+            monitor_interval_s=args.monitor_interval,
+            master_addr=master_addr,
+            master_port=master_port,
+            raw_cmd=args.no_python,
+            module=args.module,
+        )
+    except ValueError as e:  # e.g. proc range with --nnodes > 1
+        print(f"tpurun: {e}", file=sys.stderr)
+        return 2
     result = LocalElasticAgent(spec, log_dir=args.log_dir).run()
     if result.state is WorkerState.SUCCEEDED:
         return 0

@@ -194,9 +194,14 @@ class Attention(nn.Module):
 
 
 def _flash_ok(L: int, Dh: int) -> bool:
-    # kernel constraint: L divisible by the (clamped) block size
-    b = min(128, L)
-    return L % b == 0 and Dh <= 256
+    # kernel constraint: L divisible by the EFFECTIVE block sizes —
+    # per-call/env overrides (TDX_FLASH_BLOCK_Q/K) included, so an
+    # override that breaks divisibility falls back to dense attention
+    # instead of raising at trace time
+    from ..ops.flash_attention import resolved_block_sizes
+
+    bq, bk = resolved_block_sizes(L)
+    return L % bq == 0 and L % bk == 0 and Dh <= 256
 
 
 class MLP(nn.Module):
@@ -279,9 +284,16 @@ class TransformerLM(nn.Module):
         )(tokens)
         rope_len = cfg.max_seq_len if decode else tokens.shape[1]
         cos, sin = rope_freqs(cfg.head_dim, rope_len, cfg.rope_theta)
-        block_cls = nn.remat(Block) if (cfg.remat and not decode) else Block
+        # remat path: `decode` must NOT flow through nn.remat as a traced
+        # positional (TracerBoolConversionError at `if decode:`); the
+        # rematted path is always decode=False, so rely on the default
+        use_remat = cfg.remat and not decode
+        block_cls = nn.remat(Block) if use_remat else Block
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin, decode)
+            if use_remat:
+                x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin)
+            else:
+                x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin, decode)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

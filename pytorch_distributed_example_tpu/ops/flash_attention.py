@@ -300,6 +300,25 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def resolved_block_sizes(
+    L: int,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> tuple:
+    """The effective (block_q, block_k) `flash_attention` will use for a
+    given sequence length: per-call override, else `TDX_FLASH_BLOCK_Q` /
+    `TDX_FLASH_BLOCK_K` env, else 128, each clamped to L. Callers that
+    gate on divisibility (e.g. models.transformer._flash_ok) must check
+    against THESE, not the hard-coded default."""
+    import os
+
+    if block_q is None:
+        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 128))
+    if block_k is None:
+        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 128))
+    return min(block_q, L), min(block_k, L)
+
+
 def flash_attention(
     q,
     k,
@@ -321,16 +340,10 @@ def flash_attention(
     streaming-HBM variant for longer L is ring attention over the mesh
     (parallel/context_parallel.py), which calls this kernel per shard.
     """
-    import os
-
     B, L, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    if block_q is None:
-        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 128))
-    if block_k is None:
-        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 128))
-    bq, bk = min(block_q, L), min(block_k, L)
+    bq, bk = resolved_block_sizes(L, block_q, block_k)
     if L % bq or L % bk:
         raise ValueError(f"seq len {L} must be divisible by block sizes ({bq},{bk})")
     if interpret is None:

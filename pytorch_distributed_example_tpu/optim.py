@@ -56,11 +56,15 @@ class ZeroRedundancyOptimizer:
             # keep state leaves dim-0 sharded so XLA keeps the optimizer
             # math partitioned (the GSPMD train-step paths)
             state = shd.constrain_dim0(state, self.mesh, self.axis)
-        except ValueError:
+        except ValueError as e:
             # inside a manual shard_map region (e.g. the DDP compiled
             # step) sharding constraints over the mapped mesh are not
-            # expressible; state follows the surrounding layout there
-            pass
+            # expressible; state follows the surrounding layout there.
+            # Only that specific condition is tolerated ("axes should be
+            # of type Manual" / "manual" tracer errors) — any other
+            # ValueError is a genuine mesh/sharding bug and propagates.
+            if "manual" not in str(e).lower():
+                raise
         return updates, state
 
     def consolidate_state_dict(self, state):

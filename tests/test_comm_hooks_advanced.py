@@ -25,6 +25,7 @@ def _loss_fn():
 
 
 class TestPowerSGD:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_full_rank_matches_plain_allreduce(self, world):
         """r >= min(n, m): P spans the full column space, so P P^T M == M —
         the compressed reduction must reproduce pmean(grads) exactly."""

@@ -73,6 +73,7 @@ class TestDDPTraining:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.7
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_ddp_matches_single_device_sgd(self, convnet_setup, world):
         """Gradient pmean over shards == full-batch gradient: DDP step on
         W shards must equal a single big-batch step (the core DDP

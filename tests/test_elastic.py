@@ -13,6 +13,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess gangs: excluded from the <2 min habit run
+
 from pytorch_distributed_example_tpu.elastic import (
     LocalElasticAgent,
     WorkerSpec,
@@ -119,6 +121,151 @@ class TestAgent:
         spec = WorkerSpec(entrypoint=[script], nproc_per_node=2)
         res = LocalElasticAgent(spec).run()
         assert res.state is WorkerState.SUCCEEDED
+
+
+class TestDynamicWorldSize:
+    """torchelastic --nnodes=MIN:MAX semantics (torch run.py:410,
+    elastic/agent/server/api.py:455,952-970): worker loss re-forms the
+    gang at the surviving size; late joiners are admitted at the next
+    generation boundary. 4-rank gang -> kill one -> continues at 3 ->
+    rejoin -> 4."""
+
+    def _wait_for(self, predicate, timeout=60.0, what="condition"):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def test_shrink_on_kill_then_grow_on_join(self, tmp_path):
+        import signal
+        import threading
+        import time
+
+        from tests._mp_util import free_port
+
+        from pytorch_distributed_example_tpu.elastic import request_join
+
+        # Worker: prove each generation's gang really coordinates at its
+        # world size (store counter barrier), then idle until STOP.
+        script = _write(
+            tmp_path,
+            "worker.py",
+            f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO!r})
+            from pytorch_distributed_example_tpu.store import TCPStore
+
+            out = os.environ["OUT_DIR"]
+            gen = os.environ["TDX_RESTART_COUNT"]
+            rank = os.environ["RANK"]
+            world = int(os.environ["WORLD_SIZE"])
+            with open(os.path.join(out, f"pid_g{{gen}}_r{{rank}}"), "w") as f:
+                f.write(str(os.getpid()))
+
+            host, port = os.environ["TDX_AGENT_STORE"].rsplit(":", 1)
+            s = TCPStore(host, int(port), timeout=30.0)
+            s.add(f"gen{{gen}}/arrived", 1)
+            deadline = time.monotonic() + 30
+            while s.add(f"gen{{gen}}/arrived", 0) < world:
+                if time.monotonic() > deadline:
+                    sys.exit(5)
+                time.sleep(0.02)
+            # every member of THIS generation checked in at THIS size
+            with open(os.path.join(out, f"sync_g{{gen}}_w{{world}}_r{{rank}}"), "w") as f:
+                f.write("ok")
+            s.close()
+            stop = os.path.join(out, "STOP")
+            while not os.path.exists(stop):
+                time.sleep(0.02)
+            """,
+        )
+        port = free_port()
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=4,  # MAX
+            min_nproc=2,       # MIN — --nnodes=2:4 semantics
+            max_restarts=3,
+            monitor_interval_s=0.05,
+            master_port=port,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        agent = LocalElasticAgent(spec)
+        result = {}
+
+        def run():
+            result["res"] = agent.run()
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            # generation 0: full gang of 4 rendezvoused
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g0_w4_r{r}").exists() for r in range(4)
+                ),
+                what="gen0 gang of 4",
+            )
+            # kill one worker hard: the gang must re-form at 3
+            pid = int((tmp_path / "pid_g0_r3").read_text())
+            os.kill(pid, signal.SIGKILL)
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g1_w3_r{r}").exists() for r in range(3)
+                ),
+                what="gen1 gang of 3 (shrunk)",
+            )
+            assert agent.active_nproc == 3
+            # a late joiner asks in; admitted at the next generation
+            request_join("127.0.0.1", port)
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g2_w4_r{r}").exists() for r in range(4)
+                ),
+                what="gen2 gang of 4 (rejoined)",
+            )
+            assert agent.active_nproc == 4
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            t.join(timeout=60)
+        assert not t.is_alive()
+        res = result["res"]
+        assert res.state is WorkerState.SUCCEEDED, res
+        # one failure re-form + one join re-form = 2 generations past 0
+        assert res.restarts == 2, res
+        # the failure budget was charged once (joins are free)
+        assert agent._failure_restarts == 1
+
+    def test_below_min_fails(self, tmp_path):
+        """Losing workers past MIN cannot meet quorum -> job fails."""
+        script = _write(
+            tmp_path,
+            "die.py",
+            """
+            import os, sys, time
+            if os.environ["RANK"] != "0":
+                sys.exit(3)  # 3 of 4 die every generation
+            time.sleep(30)
+            """,
+        )
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=4,
+            min_nproc=2,
+            max_restarts=3,
+            monitor_interval_s=0.05,
+        )
+        res = LocalElasticAgent(spec).run()
+        assert res.state is WorkerState.FAILED
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="single-node"):
+            WorkerSpec(entrypoint=["x.py"], nnodes=2, min_nproc=1)
+        with pytest.raises(ValueError, match="min_nproc"):
+            WorkerSpec(entrypoint=["x.py"], nproc_per_node=2, min_nproc=3)
 
 
 class TestRunCLI:
@@ -256,9 +403,18 @@ class TestMultiNodeLaunch:
                 "--nproc-per-node", "8", "-m", "train.main", "--lr", "0.1",
             ]
         )
-        assert a.nnodes == 4 and a.node_rank == 2
+        assert a.nnodes == (4, 4) and a.node_rank == 2
+        assert a.nproc_per_node == (8, 8)
         assert a.rdzv_endpoint == "10.0.0.1:29500"
         assert a.module and a.entrypoint == ["train.main", "--lr", "0.1"]
+
+    def test_cli_elastic_range_parse(self):
+        from pytorch_distributed_example_tpu.elastic.run import parse_args
+
+        a = parse_args(["--nnodes", "1:4", "x.py"])
+        assert a.nnodes == (1, 4)
+        a = parse_args(["--nproc-per-node", "2:8", "x.py"])
+        assert a.nproc_per_node == (2, 8)
 
     def test_multi_node_restart_propagates(self, tmp_path):
         """A worker failure on ONE node must restart the WHOLE cluster

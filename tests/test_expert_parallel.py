@@ -101,6 +101,7 @@ class TestTopK:
 
 
 class TestMoETransformer:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_moe_transformer_trains(self):
         """TransformerLM with n_experts>0: forward shape, aux sown, loss falls,
         and the ep-sharded GSPMD layout places expert stacks over the axis."""

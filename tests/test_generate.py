@@ -33,6 +33,7 @@ def _model(n_kv_heads=None, max_seq_len=32):
 
 
 class TestDecodeParity:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_incremental_decode_matches_full_forward(self):
         """Prefill(prompt[:4]) + 4 single-token steps == causal forward."""
         import jax
@@ -83,6 +84,7 @@ class TestDecodeParity:
 
 
 class TestGenerate:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_greedy_matches_stepwise_argmax(self):
         """generate(temperature=0) == manual argmax continuation via the
         full forward (the no-cache oracle)."""

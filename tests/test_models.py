@@ -27,6 +27,7 @@ def _tiny_cfg(**kw):
 
 
 class TestResNet:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_forward_shapes(self):
         import jax
         import jax.numpy as jnp
@@ -57,6 +58,7 @@ class TestResNet:
 
 
 class TestTransformerLM:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_forward_and_loss_falls(self):
         import jax
         import jax.numpy as jnp
@@ -235,6 +237,7 @@ class TestBert:
             max_seq_len=32, dropout=0.0,
         )
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_forward_shapes(self):
         import jax
         import jax.numpy as jnp
@@ -288,6 +291,7 @@ class TestBert:
             np.asarray(h1[0, :10]), np.asarray(h2[0, :10]), atol=1e-5
         )
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_ddp_finetune_loss_falls(self, world):
         import jax
         import jax.numpy as jnp
@@ -373,6 +377,7 @@ class TestBert:
 
 
 class TestShardedTransformer:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_2d_sharded_step_matches_unsharded(self):
         """fsdp x tp GSPMD train step == single-device step (same numbers)."""
         import jax

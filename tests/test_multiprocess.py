@@ -17,6 +17,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess gangs: excluded from the <2 min habit run
+
 from tests._mp_util import REPO, free_port as _free_port, worker_env
 
 
@@ -221,6 +223,29 @@ COLLECTIVES_WORKER = textwrap.dedent(
         w.wait()
         assert buf.tolist() == [3.25, 4.5], buf
         tdx.isend(np.array([9.0, 10.0], np.float32), dst=0, tag=8).wait()
+
+    # 8b. chunked large-payload p2p + any-source recv (round-2 VERDICT
+    # #5): a payload far above TDX_P2P_CHUNK_BYTES streams through the
+    # daemon in bounded chunks; recv(src=None) polls peer keys.
+    import os as _os
+
+    _os.environ["TDX_P2P_CHUNK_BYTES"] = "4096"  # force the chunked path
+    try:
+        if rank == 0:
+            big = np.arange(8192, dtype=np.float32)  # 32 KB -> 8 chunks
+            tdx.send(big, dst=1, tag=11)
+            buf = np.zeros((3,), np.float32)
+            got_src = tdx.recv(buf, src=None, tag=12)  # any-source
+            assert got_src == 1 and buf.tolist() == [7.0, 8.0, 9.0], buf
+        elif rank == 1:
+            buf = np.zeros((8192,), np.float32)
+            w = tdx.irecv(buf, src=None, tag=11)  # any-source, deferred
+            w.wait()
+            assert w.source_rank() == 0
+            assert np.array_equal(buf, np.arange(8192, dtype=np.float32))
+            tdx.send(np.array([7.0, 8.0, 9.0], np.float32), dst=0, tag=12)
+    finally:
+        del _os.environ["TDX_P2P_CHUNK_BYTES"]
 
     # --- DDP: divergent init must become identical after wrap -------------
     import hashlib

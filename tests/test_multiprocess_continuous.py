@@ -19,6 +19,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess gangs: excluded from the <2 min habit run
+
 from tests._mp_util import REPO, free_port as _free_port, worker_env
 
 WORLD = 2

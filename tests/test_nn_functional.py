@@ -84,6 +84,7 @@ class TestAllReduce:
             rtol=1e-5,
         )
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_product_differentiable(self, mesh):
         import jax
 
@@ -95,6 +96,7 @@ class TestAllReduce:
         g = jax.grad(lambda x: f(x).sum())(x)
         assert np.isfinite(np.asarray(g)).all()
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_product_zero_input_keeps_grads_finite(self, mesh):
         """Exact zeros must not poison the backward with log(0) NaNs; the
         convention is zero forward value AND zero gradient there."""

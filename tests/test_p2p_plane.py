@@ -1,0 +1,87 @@
+"""Unit tests for the multiproc p2p data plane (no subprocesses).
+
+Round-2 VERDICT #5: large payloads must stream through the store daemon
+in bounded chunks (gloo does chunked TCP: ProcessGroupGloo.hpp p2p ops),
+and `recv(src=None)` must accept from any rank
+(torch `distributed_c10d.py:2682-2750`).
+
+These run the `_store_send` / `_store_recv` / `_store_recv_any` protocol
+directly against an in-memory HashStore with two fabricated group
+handles — the wire format and key lifecycle are what is under test; the
+cross-process path is covered in test_multiprocess.py.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu import distributed as dist
+from pytorch_distributed_example_tpu.store import HashStore
+
+
+class _G:
+    """Minimal stand-in for ProcessGroup: rank/size/store/timeout."""
+
+    def __init__(self, store, rank, size):
+        self.store = store
+        self._rank = rank
+        self._size = size
+        self.timeout = 5.0
+
+    def rank(self):
+        return self._rank
+
+    def size(self):
+        return self._size
+
+
+@pytest.fixture
+def pair():
+    store = HashStore()
+    return store, _G(store, 0, 2), _G(store, 1, 2)
+
+
+def test_small_payload_single_key(pair, monkeypatch):
+    store, g0, g1 = pair
+    monkeypatch.setenv("TDX_P2P_CHUNK_BYTES", str(1 << 20))
+    val = np.array([1.5, 2.5], np.float32)
+    dist._store_send(val, 1, g0, 0)
+    buf = np.zeros(2, np.float32)
+    out = dist._store_recv(buf, 0, g1, 0, 5.0)
+    assert np.array_equal(buf, val) and np.array_equal(out, val)
+
+
+def test_chunked_roundtrip_and_cleanup(pair, monkeypatch):
+    store, g0, g1 = pair
+    monkeypatch.setenv("TDX_P2P_CHUNK_BYTES", "1024")
+    val = np.arange(5000, dtype=np.float64)  # 40 KB -> ~40 chunks
+    dist._store_send(val, 1, g0, 3)
+    buf = np.zeros(5000, np.float64)
+    dist._store_recv(buf, 0, g1, 3, 5.0)
+    assert np.array_equal(buf, val)
+    # every key (manifest + chunks) deleted after the receive
+    assert store.num_keys() == 0
+
+
+def test_chunk_ordering_many_messages(pair, monkeypatch):
+    """Back-to-back sends on one (dst, tag) keep FIFO order through the
+    chunked path (sequence keys)."""
+    store, g0, g1 = pair
+    monkeypatch.setenv("TDX_P2P_CHUNK_BYTES", "512")
+    for i in range(4):
+        dist._store_send(np.full(400, float(i)), 1, g0, 9)
+    for i in range(4):
+        out = dist._store_recv(None, 0, g1, 9, 5.0)
+        assert out[0] == float(i)
+
+
+def test_any_source_returns_sender(pair):
+    store, g0, g1 = pair
+    dist._store_send(np.array([42.0]), 1, g0, 5)
+    src, val = dist._store_recv_any(None, g1, 5, 5.0)
+    assert src == 0 and val[0] == 42.0
+
+
+def test_any_source_times_out(pair):
+    store, g0, g1 = pair
+    with pytest.raises(TimeoutError, match="src=None"):
+        dist._store_recv_any(None, g1, 5, 0.2)

@@ -75,6 +75,7 @@ class TestShardingRules:
 
 
 class TestFSDP:
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_fsdp_matches_single_device(self, mesh8):
         """Full-shard training step == unsharded training step numerically."""
         import jax
@@ -156,6 +157,7 @@ class TestTensorParallel:
         up_cols = {s.data.shape[1] for s in sharded["mlp"]["up"]["kernel"].addressable_shards}
         assert up_cols == {16}  # 32 cols / tp=2
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_vocab_parallel_cross_entropy_matches_dense(self):
         """loss_parallel: values AND grads equal dense CE on the full
         vocab, with logits sharded (..., V/8) per rank."""

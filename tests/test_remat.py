@@ -68,6 +68,7 @@ class TestCheckpointWrapper:
         with pytest.raises(NotImplementedError):
             apply_activation_checkpointing(lambda x: x, check_fn=lambda n: True)
 
+    @pytest.mark.slow  # heavy compile/convergence; full suite only
     def test_static_kwargs_bind_train_flag(self):
         """Flax apply with dropout: train=True must be bound statically —
         this is THE use activation checkpointing exists for."""
