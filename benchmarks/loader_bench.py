@@ -1,0 +1,128 @@
+"""DataLoader worker-scaling bench — where the THREAD model saturates.
+
+Round-2 VERDICT weak #5: `data/loader.py` uses a thread pool (not
+torch's worker processes), justified for numpy-gather workloads (GIL
+released inside numpy) but expected to serialize on GIL-bound python
+decode. This bench commits the numbers for both regimes across worker
+counts, so the thread-model tradeoff is on record rather than asserted:
+
+* ``numpy``  — slicing + normalizing a preallocated array (C-level,
+  GIL released): threads should scale.
+* ``decode`` — a deliberately python-heavy per-sample transform
+  (bytes -> int loops), the shape of real python-side decode: threads
+  cannot scale past ~1x; the fix at that point is pre-decoding,
+  numpy-vectorizing, or sharding decode across PROCESSES (the elastic
+  launcher gives each rank its own loader, which is the deployment
+  answer).
+
+Usage: python benchmarks/loader_bench.py [--batches 40] [--batch 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+class _NumpyDataset:
+    """GIL-releasing workload: fancy-index + fp32 normalize."""
+
+    def __init__(self, n=8192, dim=3072):
+        import numpy as np
+
+        self.x = np.random.default_rng(0).integers(
+            0, 255, (n, dim), dtype=np.uint8
+        )
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        import numpy as np
+
+        batch = self.x[idx].astype(np.float32)
+        return (batch / 127.5 - 1.0), np.zeros(len(idx), np.int32)
+
+
+class _PyDecodeDataset:
+    """GIL-bound workload: per-sample python byte loops (decode-shaped)."""
+
+    def __init__(self, n=8192, blob=4096):
+        self.blobs = [bytes(range(256)) * (blob // 256) for _ in range(n)]
+
+    def __len__(self):
+        return len(self.blobs)
+
+    def __getitem__(self, idx):
+        import numpy as np
+
+        out = []
+        for i in idx:
+            acc = 0
+            for b in self.blobs[i]:  # pure-python per-byte work
+                acc = (acc + b) & 0xFFFF
+            out.append(acc)
+        return np.asarray(out, np.float32), np.zeros(len(idx), np.int32)
+
+
+def _throughput(loader, batches, step_s=0.0):
+    """samples/s draining the loader, optionally simulating a consumer
+    train step of `step_s` per batch — prefetch exists to hide fetch
+    UNDER the step, so the step_s>0 row is the loader's real job."""
+    it = iter(loader)
+    next(it)  # warm the pool
+    t0 = time.perf_counter()
+    n = 0
+    for i, (x, y) in enumerate(it):
+        n += len(x)
+        if step_s:
+            time.sleep(step_s)
+        if i + 1 >= batches:
+            break
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--workers", default="0,2,4,8")
+    ap.add_argument("--step-ms", type=float, default=5.0,
+                    help="simulated consumer train-step per batch; 0 = "
+                         "pure drain (measures dispatch overhead only)")
+    args = ap.parse_args()
+
+    from benchmarks.common import emit
+    from pytorch_distributed_example_tpu.data import DataLoader
+
+    step_s = args.step_ms / 1e3
+    workers = [int(x) for x in args.workers.split(",")]
+    base_w = workers[0]
+    results = []
+    for name, ds in (("numpy", _NumpyDataset()), ("decode", _PyDecodeDataset())):
+        base = None
+        for w in workers:
+            loader = DataLoader(
+                ds, batch_size=args.batch, num_workers=w, shuffle=False
+            )
+            sps = _throughput(loader, args.batches, step_s)
+            if base is None:
+                base = sps
+            rec = emit(
+                f"loader_{name}_w{w}",
+                round(sps, 1),
+                "samples/s",
+                workers=w,
+                step_ms=args.step_ms,
+                # labeled by the ACTUAL baseline (first --workers entry)
+                **{f"speedup_vs_w{base_w}": round(sps / base, 2)},
+            )
+            results.append(rec)
+    return results
+
+
+if __name__ == "__main__":
+    main()

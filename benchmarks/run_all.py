@@ -115,6 +115,24 @@ def _jobs(quick: bool):
             [sys.executable, "benchmarks/trace_evidence.py"],
             {},
         ),
+        (
+            "reducer_dispatch",
+            [sys.executable, "benchmarks/reducer_bench.py"]
+            + (["--mb", "1", "--iters", "3", "--warmup", "1"] if q else []),
+            {},
+        ),
+        (
+            "p2p_store_bw",
+            [sys.executable, "benchmarks/p2p_store_bw.py"]
+            + (["--sizes-mb", "1", "--iters", "2"] if q else []),
+            {},
+        ),
+        (
+            "loader_scaling",
+            [sys.executable, "benchmarks/loader_bench.py"]
+            + (["--batches", "10"] if q else []),
+            {},
+        ),
     ]
 
 

@@ -23,3 +23,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is compile-dominated (hundreds
+# of distinct jit programs over the 8-device mesh); caching compiled
+# executables across runs turns repeat runs from ~5 min into the actual
+# test-logic time. Safe to share — keyed by HLO + flags + backend.
+jax.config.update("jax_compilation_cache_dir", "/tmp/tdx-jax-cache")
+# only persist compiles worth the disk (JAX has no default eviction; a
+# zero threshold would grow the shared dir without bound)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
