@@ -139,6 +139,7 @@ def main():
                 )
             )
         size *= 4
+    emit("collective_bw_summary", len(results), "rows", rows=results)
     return results
 
 

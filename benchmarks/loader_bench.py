@@ -71,12 +71,15 @@ class _PyDecodeDataset:
 def _throughput(loader, batches, step_s=0.0):
     """samples/s draining the loader, optionally simulating a consumer
     train step of `step_s` per batch — prefetch exists to hide fetch
-    UNDER the step, so the step_s>0 row is the loader's real job."""
-    it = iter(loader)
-    next(it)  # warm the pool
+    UNDER the step, so the step_s>0 row is the loader's real job.
+
+    The clock covers iterator creation through the last batch: starting
+    it after a warm-up `next()` would let the pool bank up to
+    num_workers finished batches outside the window, inflating
+    multi-worker rows (especially at small --batches)."""
     t0 = time.perf_counter()
     n = 0
-    for i, (x, y) in enumerate(it):
+    for i, (x, y) in enumerate(loader):
         n += len(x)
         if step_s:
             time.sleep(step_s)
@@ -121,6 +124,7 @@ def main():
                 **{f"speedup_vs_w{base_w}": round(sps / base, 2)},
             )
             results.append(rec)
+    emit("loader_scaling_summary", len(results), "rows", rows=results)
     return results
 
 

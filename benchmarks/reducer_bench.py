@@ -84,12 +84,16 @@ def main():
         # rank-stacked, and from_process_local re-replicates per rank.
         flat = np.concatenate([np.asarray(v)[0].ravel() for v in grads.values()])
         dt = DistTensor.from_process_local(flat, g)
+        # AVG, matching Reducer.reduce's mean semantics — a SUM floor
+        # would shift the world-size divide into the measured gap
+        from pytorch_distributed_example_tpu import ReduceOp
+
         for _ in range(args.warmup):
-            tdx.all_reduce(dt)
+            tdx.all_reduce(dt, ReduceOp.AVG)
         dt.block_until_ready()
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            tdx.all_reduce(dt)
+            tdx.all_reduce(dt, ReduceOp.AVG)
         dt.block_until_ready()
         backend_ms = (time.perf_counter() - t0) / args.iters * 1e3
 
@@ -106,6 +110,7 @@ def main():
                 world=tdx.get_world_size(),
             )
         )
+    emit("reducer_dispatch_summary", len(results), "rows", rows=results)
     return results
 
 

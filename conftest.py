@@ -28,7 +28,13 @@ jax.config.update("jax_platforms", "cpu")
 # of distinct jit programs over the 8-device mesh); caching compiled
 # executables across runs turns repeat runs from ~5 min into the actual
 # test-logic time. Safe to share — keyed by HLO + flags + backend.
-jax.config.update("jax_compilation_cache_dir", "/tmp/tdx-jax-cache")
+import getpass  # noqa: E402
+import tempfile  # noqa: E402
+
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    tempfile.gettempdir(), f"tdx-jax-cache-{getpass.getuser()}"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 # only persist compiles worth the disk (JAX has no default eviction; a
-# zero threshold would grow the shared dir without bound)
+# zero threshold would grow the dir without bound)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

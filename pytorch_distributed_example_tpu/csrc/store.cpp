@@ -180,6 +180,30 @@ struct Daemon {
       std::string key = c.buf.substr(5, klen);
       std::string val = c.buf.substr(5 + klen + 4, vlen);
       c.buf.erase(0, total);
+      if (cmd == CMD_GET) {
+        // zero-copy response for the data-plane hot path: stream the
+        // stored value straight out of the map instead of building a
+        // [len][flag][value] string (two O(bytes) copies per GET)
+        std::lock_guard<std::mutex> lock(mu);
+        auto it = data.find(key);
+        if (it == data.end()) {
+          uint32_t rlen = 1;
+          char miss[5];
+          memcpy(miss, &rlen, 4);
+          miss[4] = '\x00';
+          if (!send_all(fd, miss, 5)) return false;
+        } else {
+          uint32_t rlen = static_cast<uint32_t>(1 + it->second.size());
+          char hdr[5];
+          memcpy(hdr, &rlen, 4);
+          hdr[4] = '\x01';
+          if (!send_all(fd, hdr, 5)) return false;
+          if (!it->second.empty() &&
+              !send_all(fd, it->second.data(), it->second.size()))
+            return false;
+        }
+        continue;
+      }
       // move the value into dispatch: SET stores it without another
       // O(bytes) copy (matters on the chunked p2p data-plane path)
       std::string resp = dispatch(cmd, key, std::move(val));
