@@ -107,11 +107,8 @@ struct Daemon {
       case CMD_SET:
         data[key] = std::move(val);
         return "ok";
-      case CMD_GET: {
-        auto it = data.find(key);
-        if (it == data.end()) return std::string("\x00", 1);
-        return std::string("\x01", 1) + it->second;
-      }
+      // CMD_GET is answered by drain_frames' zero-copy fast path and
+      // never reaches dispatch()
       case CMD_ADD: {
         long long cur = 0;
         auto it = data.find(key);

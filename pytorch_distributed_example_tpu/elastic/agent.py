@@ -55,16 +55,35 @@ class WorkerSpec:
     node_rank: int = 0  # torchrun --node-rank; node 0 hosts the store
     peer_done_timeout_s: float = 600.0  # max finish-time skew across nodes
     # Dynamic world size (torchrun --nnodes=MIN:MAX semantics,
-    # run.py:410): when set, the local worker group is ELASTIC —
-    # `nproc_per_node` is the MAX size; a worker failure re-forms the
-    # gang at the surviving size as long as it stays >= min_nproc, and
-    # late joiners (`request_join`) are admitted at the next generation
-    # boundary up to the max. Single-node only (the elastic unit here is
-    # the local worker; multi-node gangs stay fixed-size).
+    # run.py:410), at two granularities:
+    #
+    # * `min_nproc` — the LOCAL worker group is elastic (single node):
+    #   `nproc_per_node` is the MAX; a worker failure re-forms the gang
+    #   at the surviving size as long as it stays >= min_nproc, and late
+    #   joiners (`request_join`) are admitted at the next generation
+    #   boundary up to the max.
+    # * `min_nnodes` — NODE-level elastic (torchelastic's real --nnodes
+    #   semantics): `nnodes` is the MAX node count; agents heartbeat
+    #   through the store, a stale peer heartbeat re-forms the gang with
+    #   the surviving nodes (>= min_nnodes), node ranks are reassigned
+    #   by membership order each generation, and an agent that starts
+    #   late (or missed a generation) is admitted at the next boundary.
+    #   Node 0 hosts the rendezvous store and is therefore NOT
+    #   survivable — the same single-point rendezvous host torch's c10d
+    #   rendezvous backend has (torch rendezvous.py:196: rank 0 binds).
     min_nproc: Optional[int] = None
+    min_nnodes: Optional[int] = None
+    node_settle_s: float = 2.0  # membership settle window per generation
+    heartbeat_timeout_s: float = 5.0  # stale-heartbeat node-loss threshold
+    quorum_grace_s: float = 60.0  # keep re-forming below min for this long
     env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
+        if self.min_nproc is not None and self.min_nnodes is not None:
+            raise ValueError(
+                "combine min_nproc with min_nnodes is ambiguous; "
+                "pick ONE elastic granularity"
+            )
         if self.min_nproc is not None:
             if self.nnodes != 1:
                 raise ValueError(
@@ -75,10 +94,30 @@ class WorkerSpec:
                     f"min_nproc {self.min_nproc} must be in "
                     f"[1, nproc_per_node={self.nproc_per_node}]"
                 )
+        if self.min_nnodes is not None:
+            if not 1 <= self.min_nnodes <= self.nnodes:
+                raise ValueError(
+                    f"min_nnodes {self.min_nnodes} must be in "
+                    f"[1, nnodes={self.nnodes}]"
+                )
+            if self.master_port == 0:
+                raise ValueError(
+                    "node-elastic launch needs an explicit master/rdzv "
+                    "port (peers and joiners must find the store)"
+                )
+            if self.nnodes < 2:
+                raise ValueError(
+                    "node-elastic (min_nnodes) needs nnodes (the MAX) "
+                    ">= 2; for a single-node worker range use min_nproc"
+                )
 
     @property
     def elastic(self) -> bool:
         return self.min_nproc is not None
+
+    @property
+    def node_elastic(self) -> bool:
+        return self.min_nnodes is not None
 
     @property
     def world_size(self) -> int:
@@ -90,6 +129,11 @@ class _Worker:
     local_rank: int
     proc: Optional[subprocess.Popen] = None
     state: WorkerState = WorkerState.INIT
+
+
+class _AgentAborted(Exception):
+    """Internal: raised inside the monitor when `abort()` simulated a
+    crashed agent; unwinds run() without any store writes."""
 
 
 @dataclass
@@ -140,6 +184,13 @@ class LocalElasticAgent:
         # (host, bound_port) of the store once hosting starts — the
         # address request_join callers need (standalone specs say port 0)
         self.join_endpoint: Optional[tuple] = None
+        # node-elastic membership: permanent node ids currently in the
+        # gang (sorted) and this node's position in it (the per-
+        # generation GROUP_RANK). Fixed-size gangs never change these.
+        self.members: List[int] = list(range(spec.nnodes))
+        self.group_rank: int = spec.node_rank
+        self._local_failure = False
+        self._quorum_deadline: Optional[float] = None
 
     # -- store hosting -----------------------------------------------------
     def _ensure_store(self) -> Optional[TCPStore]:
@@ -226,15 +277,23 @@ class LocalElasticAgent:
         # elastic gangs spawn the CURRENT size (shrunk/grown across
         # generations); fixed-size gangs always spawn the spec size
         nproc = self.active_nproc if self.spec.elastic else self.spec.nproc_per_node
-        world = nproc if self.spec.elastic else self.spec.world_size
+        if self.spec.node_elastic:
+            # per-generation membership: world spans the CURRENT members,
+            # ranks keyed by this node's membership index
+            world = len(self.members) * nproc
+            grank = self.group_rank
+        else:
+            world = nproc if self.spec.elastic else self.spec.world_size
+            grank = self.spec.node_rank
         for r in range(nproc):
-            global_rank = self.spec.node_rank * nproc + r
+            global_rank = grank * nproc + r
             env = {
                 **os.environ,
                 **self.spec.env,
                 "RANK": str(global_rank),
                 "LOCAL_RANK": str(r),
-                "GROUP_RANK": str(self.spec.node_rank),
+                "GROUP_RANK": str(grank),
+                "TDX_NODE_ID": str(self.spec.node_rank),  # permanent id
                 "LOCAL_WORLD_SIZE": str(nproc),
                 "WORLD_SIZE": str(world),
                 "MASTER_ADDR": self.spec.master_addr,
@@ -433,9 +492,354 @@ class LocalElasticAgent:
             return False
         return self._peek(ctrl, "agent/fatal") is None
 
+    # -- node-level elastic (torchelastic --nnodes=MIN:MAX) ----------------
+    def abort(self) -> None:
+        """Simulate abrupt agent death (SIGKILL of the agent process):
+        stop heartbeating and coordinating entirely; `run()` returns
+        FAILED without writing to the store. Peers learn of the loss the
+        only way they can for a real crash — heartbeat staleness. Used
+        by fault-injection tests."""
+        self._aborted = True
+
+    def _check_abort(self) -> None:
+        # every node-elastic wait loop must observe abort(), not just the
+        # monitor — an aborted agent must stop ALL store coordination
+        if getattr(self, "_aborted", False):
+            raise _AgentAborted()
+
+    @staticmethod
+    def _hb_key(node: int) -> str:
+        return f"agent/hb/node{node}"
+
+    def _heartbeat(self, ctrl) -> None:
+        if getattr(self, "_aborted", False):
+            return
+        try:
+            ctrl.set(self._hb_key(self.spec.node_rank), str(time.time()))
+        except Exception:
+            pass  # store host gone; staleness/fatal paths will decide
+
+    def _stale_peers(self, ctrl) -> List[int]:
+        """Current members whose heartbeat is older than the threshold —
+        the node-loss detector (torchelastic learns this from its
+        rendezvous keep-alive the same way)."""
+        now = time.time()
+        out = []
+        for m in self.members:
+            if m == self.spec.node_rank:
+                continue
+            v = self._peek(ctrl, self._hb_key(m))
+            try:
+                fresh = v is not None and (
+                    now - float(v) <= self.spec.heartbeat_timeout_s
+                )
+            except ValueError:
+                fresh = False
+            if not fresh:
+                out.append(m)
+        return out
+
+    def _peeked_gen(self, ctrl) -> int:
+        g = self._peek(ctrl, "agent/restart_gen")
+        return int(g) if g is not None else 0
+
+    def _bump_gen(self, ctrl, target: int) -> None:
+        try:
+            ctrl.set("agent/restart_gen", str(target))
+        except Exception:
+            pass
+
+    def _fresh_hb_nodes(self, ctrl) -> List[int]:
+        now = time.time()
+        out = []
+        for n in range(self.spec.nnodes):
+            v = self._peek(ctrl, self._hb_key(n))
+            try:
+                if v is not None and now - float(v) <= self.spec.heartbeat_timeout_s:
+                    out.append(n)
+            except ValueError:
+                pass
+        return out
+
+    def _form_membership(self, ctrl, target: int) -> str:
+        """Generation barrier with DYNAMIC membership: every present node
+        writes a ready key, the settle window closes, and the first node
+        to publish wins the members list (store compare-and-set). The
+        proposal is ready nodes UNION fresh-heartbeat nodes, so an
+        incumbent slow through a long worker teardown cannot be evicted
+        by a joiner racing the settle window. Node ranks are reassigned
+        by membership order. Returns "ok" (member), "wait" (missed this
+        generation — rejoin at the next), "retry" (below min quorum —
+        re-form while the quorum grace lasts), or "fatal"."""
+        me = self.spec.node_rank
+        self._check_abort()
+        self._heartbeat(ctrl)
+        try:
+            ctrl.set(f"agent/gen{target}/ready/{me}", b"1")
+        except Exception:
+            return "fatal"
+        time.sleep(self.spec.node_settle_s)
+        self._check_abort()
+        self._heartbeat(ctrl)
+        ready = {
+            n
+            for n in range(self.spec.nnodes)
+            if self._peek(ctrl, f"agent/gen{target}/ready/{n}") is not None
+        }
+        proposal_set = sorted(ready | set(self._fresh_hb_nodes(ctrl)))
+        proposal = ",".join(str(n) for n in proposal_set).encode()
+        try:
+            published = ctrl.compare_set(
+                f"agent/gen{target}/members", b"", proposal
+            )
+        except Exception:
+            return "fatal"
+        members = [int(x) for x in published.decode().split(",") if x]
+        if me not in members:
+            return "wait"
+        if len(members) < (self.spec.min_nnodes or 1):
+            # below min: not instantly fatal — peers may be mid-teardown.
+            # Keep re-forming for the quorum grace window (torchelastic
+            # waits a join timeout for min nodes the same way).
+            if self._quorum_deadline is None:
+                self._quorum_deadline = (
+                    time.monotonic() + self.spec.quorum_grace_s
+                )
+            if time.monotonic() < self._quorum_deadline:
+                self.restart_count = target
+                return "retry"
+            try:
+                ctrl.set("agent/fatal", b"1")
+            except Exception:
+                pass
+            return "fatal"
+        self._quorum_deadline = None
+        self.members = members
+        self.group_rank = members.index(me)
+        self.restart_count = target
+        for n in members:  # these join requests are now honored
+            try:
+                ctrl.delete_key(f"agent/join_node/{n}")
+            except Exception:
+                pass
+        return "ok"
+
+    def _monitor_node_elastic(self, ctrl) -> WorkerState:
+        """Monitor loop for node-elastic gangs: local worker exits, peer
+        generation bumps, stale peer heartbeats (node loss), and — on the
+        leader (lowest member) — queued node joins."""
+        leader = self.members[0] == self.spec.node_rank
+        while True:
+            time.sleep(self.spec.monitor_interval_s)
+            if getattr(self, "_aborted", False):
+                raise _AgentAborted()
+            self._heartbeat(ctrl)
+            codes = {w.local_rank: w.proc.poll() for w in self._workers}
+            if any(c is not None and c != 0 for c in codes.values()):
+                self._observed_failed = sum(
+                    1 for c in codes.values() if c is not None and c != 0
+                )
+                self._local_failure = True
+                self._bump_gen(ctrl, self.restart_count + 1)
+                return WorkerState.FAILED
+            if all(c == 0 for c in codes.values()):
+                return WorkerState.SUCCEEDED
+            if self._peek(ctrl, "agent/fatal") is not None:
+                return WorkerState.FAILED
+            if self._peeked_gen(ctrl) > self.restart_count:
+                return WorkerState.FAILED  # peer-signaled membership change
+            if self._stale_peers(ctrl):
+                self._bump_gen(ctrl, self.restart_count + 1)
+                return WorkerState.FAILED
+            if leader:
+                for n in range(self.spec.nnodes):
+                    if n in self.members:
+                        continue
+                    v = self._peek(ctrl, f"agent/join_node/{n}")
+                    if v is None:
+                        continue
+                    # join keys carry the joiner's timestamp and are
+                    # refreshed while it waits: a stale key is a joiner
+                    # that crashed before admission — drop it instead of
+                    # re-forming the gang forever
+                    try:
+                        fresh = (
+                            time.time() - float(v)
+                            <= self.spec.heartbeat_timeout_s
+                        )
+                    except ValueError:
+                        fresh = False
+                    if not fresh:
+                        try:
+                            ctrl.delete_key(f"agent/join_node/{n}")
+                        except Exception:
+                            pass
+                        continue
+                    self._bump_gen(ctrl, self.restart_count + 1)
+                    return WorkerState.FAILED
+
+    def _await_members_done(self, ctrl) -> str:
+        """Success path over the CURRENT membership (the fixed-size
+        `_await_peers_done` ranges over all spec nodes)."""
+        gen = self.restart_count
+        me = self.spec.node_rank
+        try:
+            ctrl.set(f"agent/done/gen{gen}/node{me}", b"1")
+        except Exception:
+            return "fatal"
+        deadline = time.monotonic() + self.spec.peer_done_timeout_s
+        while time.monotonic() < deadline:
+            self._check_abort()
+            self._heartbeat(ctrl)
+            if self._peek(ctrl, "agent/fatal") is not None:
+                return "fatal"
+            if self._peeked_gen(ctrl) > self.restart_count:
+                return "restart"
+            if all(
+                self._peek(ctrl, f"agent/done/gen{gen}/node{n}") is not None
+                for n in self.members
+            ):
+                # two-phase: the store HOST (node 0) must outlive every
+                # peer's observation of the done keys — returning first
+                # would close the daemon under the others' final polls
+                try:
+                    ctrl.set(f"agent/done_ack/gen{gen}/node{me}", b"1")
+                except Exception:
+                    pass
+                if self.spec.node_rank == 0:
+                    try:
+                        ctrl.wait(
+                            [
+                                f"agent/done_ack/gen{gen}/node{n}"
+                                for n in self.members
+                            ],
+                            60.0,
+                        )
+                    except Exception:
+                        pass  # a peer died post-done; nothing to protect
+                return "done"
+            time.sleep(self.spec.monitor_interval_s)
+        try:
+            ctrl.set("agent/fatal", b"1")
+        except Exception:
+            pass
+        return "fatal"
+
+    def _codes(self) -> Dict[int, int]:
+        return {
+            w.local_rank: (w.proc.returncode if w.proc else None)
+            for w in self._workers
+        }
+
+    def _run_node_elastic(self) -> RunResult:
+        try:
+            return self._run_node_elastic_inner()
+        except _AgentAborted:
+            # crashed-agent simulation: die without store coordination
+            self._stop_workers()
+            return RunResult(
+                WorkerState.FAILED, self.restart_count, self._codes()
+            )
+
+    def _run_node_elastic_inner(self) -> RunResult:
+        ctrl = self._control()
+        if ctrl is None:  # unreachable given spec validation (nnodes >= 2)
+            raise RuntimeError("node-elastic requires the shared store")
+        target = self._peeked_gen(ctrl)
+        join_deadline = None
+        while True:
+            verdict = self._form_membership(ctrl, target)
+            if verdict == "fatal":
+                return RunResult(
+                    WorkerState.FAILED, self.restart_count, self._codes()
+                )
+            if verdict == "retry":
+                # below min quorum within the grace window: open the next
+                # generation and re-form (peers mid-teardown will make it)
+                target = max(self._peeked_gen(ctrl), target + 1)
+                self._bump_gen(ctrl, target)
+                continue
+            if verdict == "wait":
+                # missed this generation: announce as joiner (timestamped,
+                # refreshed — the leader drops stale keys from crashed
+                # joiners) and wait for the next generation to open
+                if join_deadline is None:
+                    join_deadline = time.monotonic() + 300.0
+                while True:
+                    self._check_abort()
+                    try:
+                        ctrl.set(
+                            f"agent/join_node/{self.spec.node_rank}",
+                            str(time.time()),
+                        )
+                    except Exception:
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            self._codes(),
+                        )
+                    g = self._peeked_gen(ctrl)
+                    if g > target:
+                        target = g
+                        break
+                    if (
+                        self._peek(ctrl, "agent/fatal") is not None
+                        or time.monotonic() > join_deadline
+                    ):
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            self._codes(),
+                        )
+                    time.sleep(self.spec.monitor_interval_s)
+                continue
+            join_deadline = None
+            self._start_workers()
+            state = self._monitor_node_elastic(ctrl)
+            if state is WorkerState.SUCCEEDED:
+                done = self._await_members_done(ctrl)
+                if done == "done":
+                    return RunResult(
+                        WorkerState.SUCCEEDED,
+                        self.restart_count,
+                        self._codes(),
+                    )
+                if done == "fatal":
+                    return RunResult(
+                        WorkerState.FAILED, self.restart_count, self._codes()
+                    )
+                # "restart": rejoin the gang for the next generation
+            # bracket the (potentially slow) teardown with heartbeats so
+            # a SIGTERM-ignoring worker's kill wait cannot make THIS node
+            # look dead to its peers
+            self._heartbeat(ctrl)
+            self._stop_workers()
+            self._heartbeat(ctrl)
+            if self._peek(ctrl, "agent/fatal") is not None:
+                return RunResult(
+                    WorkerState.FAILED, self.restart_count, self._codes()
+                )
+            if self._local_failure:
+                # only REAL local failures consume the budget; membership
+                # changes (node loss/join re-forms) are free, as in
+                # torchelastic
+                self._local_failure = False
+                self._failure_restarts += 1
+                if self._failure_restarts > self.spec.max_restarts:
+                    try:
+                        ctrl.set("agent/fatal", b"1")
+                    except Exception:
+                        pass
+                    return RunResult(
+                        WorkerState.FAILED, self.restart_count, self._codes()
+                    )
+            target = max(self._peeked_gen(ctrl), self.restart_count + 1)
+
     # -- run with restarts (api.py:952-970) -------------------------------
     def run(self) -> RunResult:
         try:
+            if self.spec.node_elastic:
+                return self._run_node_elastic()
             self._start_workers()
             while True:
                 state = self._monitor()

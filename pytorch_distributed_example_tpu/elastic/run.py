@@ -100,25 +100,35 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    min_nnodes = None
     if min_nodes != max_nodes:
-        # elastic NODE range: a single local agent hosts the whole gang,
-        # so the node range maps onto the worker-group range (the gang
-        # scales between min_nodes*nproc and max_nodes*nproc workers)
-        if args.node_rank != 0:
-            print(
-                "tpurun: --nnodes MIN:MAX requires a single agent "
-                "(node-rank 0) hosting the elastic worker group",
-                file=sys.stderr,
-            )
-            return 2
-        min_proc, max_proc = min_nodes * max_proc, max_nodes * max_proc
-        min_nodes = max_nodes = 1
+        if master_port != 0:
+            # real multi-agent deployment (explicit rendezvous port):
+            # NODE-level elastic — each node runs its own agent; agents
+            # heartbeat through the store, re-form on node loss, and
+            # admit late-started agents at generation boundaries
+            min_nnodes = min_nodes
+        else:
+            # standalone: a single local agent hosts the whole gang, so
+            # the node range maps onto the worker-group range (the gang
+            # scales between min*nproc and max*nproc workers)
+            if args.node_rank != 0:
+                print(
+                    "tpurun: standalone --nnodes MIN:MAX requires a "
+                    "single agent (node-rank 0); give --rdzv-endpoint "
+                    "for true multi-node elasticity",
+                    file=sys.stderr,
+                )
+                return 2
+            min_proc, max_proc = min_nodes * max_proc, max_nodes * max_proc
+            min_nodes = max_nodes = 1
     try:
         spec = WorkerSpec(
             entrypoint=args.entrypoint,
             nproc_per_node=max_proc,
             min_nproc=min_proc if min_proc != max_proc else None,
             nnodes=max_nodes,
+            min_nnodes=min_nnodes,
             node_rank=args.node_rank,
             max_restarts=args.max_restarts,
             monitor_interval_s=args.monitor_interval,

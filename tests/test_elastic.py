@@ -268,6 +268,134 @@ class TestDynamicWorldSize:
             WorkerSpec(entrypoint=["x.py"], nproc_per_node=2, min_nproc=3)
 
 
+class TestNodeElastic:
+    """NODE-level --nnodes=MIN:MAX (torchelastic's real semantics,
+    torch run.py:410 + elastic/agent/server/api.py:455): agents
+    heartbeat through the store; a dead agent's staleness re-forms the
+    gang with the survivors at reassigned node ranks; a late-started
+    agent is admitted at the next generation boundary."""
+
+    WORKER = """
+        import os, sys, time
+        out = os.environ["OUT_DIR"]
+        gen = os.environ["TDX_RESTART_COUNT"]
+        world = os.environ["WORLD_SIZE"]
+        rank = os.environ["RANK"]
+        with open(os.path.join(out, f"run_g{gen}_w{world}_r{rank}"), "w") as f:
+            f.write(os.environ["GROUP_RANK"])
+        stop = os.path.join(out, "STOP")
+        while not os.path.exists(stop):
+            time.sleep(0.02)
+        """
+
+    def _spec(self, tmp_path, port, node_rank, **kw):
+        script = _write(tmp_path, f"worker{node_rank}.py", self.WORKER)
+        return WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=1,
+            nnodes=2,
+            min_nnodes=1,
+            node_rank=node_rank,
+            master_port=port,
+            monitor_interval_s=0.05,
+            node_settle_s=0.4,
+            heartbeat_timeout_s=1.0,
+            max_restarts=3,
+            env={"OUT_DIR": str(tmp_path)},
+            **kw,
+        )
+
+    def _wait_for(self, predicate, timeout=60.0, what="condition"):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def test_node_loss_shrinks_then_join_grows(self, tmp_path):
+        import threading
+
+        from tests._mp_util import free_port
+
+        port = free_port()
+        agents = {n: LocalElasticAgent(self._spec(tmp_path, port, n)) for n in (0, 1)}
+        results = {}
+        threads = {
+            n: threading.Thread(target=lambda n=n: results.update({n: agents[n].run()}))
+            for n in agents
+        }
+        threads[0].start()
+        threads[1].start()
+        try:
+            # generation 0: both nodes in, world 2
+            self._wait_for(
+                lambda: (tmp_path / "run_g0_w2_r0").exists()
+                and (tmp_path / "run_g0_w2_r1").exists(),
+                what="gen0 two-node gang",
+            )
+            # node 1 dies abruptly (agent + worker): heartbeat goes stale
+            agents[1].abort()
+            # node 0 must re-form ALONE (world 1) within the hb timeout
+            self._wait_for(
+                lambda: any(
+                    (tmp_path / f"run_g{g}_w1_r0").exists() for g in (1, 2, 3)
+                ),
+                timeout=90.0,
+                what="solo re-form after node loss",
+            )
+            assert agents[0].members == [0]
+            # a REPLACEMENT node 1 starts late: admitted at next boundary
+            agents[2] = LocalElasticAgent(self._spec(tmp_path, port, 1))
+            threads[2] = threading.Thread(
+                target=lambda: results.update({2: agents[2].run()})
+            )
+            threads[2].start()
+            self._wait_for(
+                lambda: any(
+                    (tmp_path / f"run_g{g}_w2_r1").exists() for g in (2, 3, 4, 5)
+                ),
+                timeout=90.0,
+                what="rejoined two-node gang",
+            )
+            assert sorted(agents[0].members) == [0, 1]
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            for t in threads.values():
+                t.join(timeout=60)
+        assert results[0].state is WorkerState.SUCCEEDED, results
+        assert results[2].state is WorkerState.SUCCEEDED, results
+        # membership changes were free; no local worker ever failed
+        assert agents[0]._failure_restarts == 0
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="explicit master"):
+            WorkerSpec(entrypoint=["x"], nnodes=2, min_nnodes=1)
+        with pytest.raises(ValueError, match="nnodes"):
+            WorkerSpec(
+                entrypoint=["x"], nnodes=1, min_nnodes=1, master_port=1234
+            )
+        with pytest.raises(ValueError, match="ambiguous"):
+            WorkerSpec(
+                entrypoint=["x"],
+                nnodes=2,
+                min_nnodes=1,
+                nproc_per_node=4,
+                min_nproc=2,
+                master_port=1234,
+            )
+
+    def test_cli_maps_rdzv_range_to_node_elastic(self):
+        from pytorch_distributed_example_tpu.elastic.run import parse_args
+
+        a = parse_args(
+            ["--nnodes", "1:4", "--rdzv-endpoint", "10.0.0.1:29500", "x.py"]
+        )
+        assert a.nnodes == (1, 4)
+
+
 class TestRunCLI:
     def test_tpurun_end_to_end(self, tmp_path):
         script = _write(
