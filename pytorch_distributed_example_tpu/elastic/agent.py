@@ -110,6 +110,12 @@ class WorkerSpec:
                     "node-elastic (min_nnodes) needs nnodes (the MAX) "
                     ">= 2; for a single-node worker range use min_nproc"
                 )
+            if not 0 <= self.node_rank < self.nnodes:
+                raise ValueError(
+                    f"node_rank {self.node_rank} out of range for "
+                    f"nnodes={self.nnodes} (membership scans cover "
+                    f"0..{self.nnodes - 1})"
+                )
 
     @property
     def elastic(self) -> bool:
@@ -544,8 +550,21 @@ class LocalElasticAgent:
         return int(g) if g is not None else 0
 
     def _bump_gen(self, ctrl, target: int) -> None:
+        # monotonic: concurrent bumpers must never move the counter
+        # BACKWARDS (two live generations would form simultaneously);
+        # compare-and-set loop instead of a blind write
         try:
-            ctrl.set("agent/restart_gen", str(target))
+            for _ in range(16):
+                cur = self._peek(ctrl, "agent/restart_gen")
+                cur_i = int(cur) if cur is not None else 0
+                if cur_i >= target:
+                    return
+                expected = cur if cur is not None else b""
+                got = ctrl.compare_set(
+                    "agent/restart_gen", expected, str(target).encode()
+                )
+                if got == str(target).encode():
+                    return
         except Exception:
             pass
 
@@ -695,6 +714,20 @@ class LocalElasticAgent:
                 return "fatal"
             if self._peeked_gen(ctrl) > self.restart_count:
                 return "restart"
+            # a member dying between its workers' success and its done
+            # key would otherwise block everyone for the full
+            # peer_done_timeout: treat it as the node loss it is
+            stale = self._stale_peers(ctrl)
+            if stale:
+                not_done = [
+                    n
+                    for n in stale
+                    if self._peek(ctrl, f"agent/done/gen{gen}/node{n}")
+                    is None
+                ]
+                if not_done:
+                    self._bump_gen(ctrl, self.restart_count + 1)
+                    return "restart"
             if all(
                 self._peek(ctrl, f"agent/done/gen{gen}/node{n}") is not None
                 for n in self.members

@@ -194,9 +194,13 @@ def _sync_module_states(params, group, bucket_mb: float = 250.0):
                 dt.array.addressable_shards,
                 key=lambda s: s.index[0].start or 0,
             )
-            row = shards[0].data[0]
+            # one D2H copy of the post-broadcast bytes: the replicate
+            # step (c) jits onto the MULTI-HOST mesh, which accepts
+            # uncommitted host values but not single-device arrays
+            # (every process feeds the identical synced value)
+            row = np.asarray(jax.device_get(shards[0].data))[0]
         else:
-            row = dt.array[0]
+            row = dt.array[0]  # device-resident end to end
         off = 0
         for j in bucket:
             n = leaves[j].size
