@@ -305,7 +305,7 @@ def _bench_mfu(jax, is_tpu: bool):
             updates, opt_state2 = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state2, loss
 
-        return step, params, opt_state, toks
+        return step, params, opt_state, toks, model
 
     # No SILENT fallback (round-2 verdict): a flash-compile failure on
     # real TPU must be visible in the emitted JSON, not just cost MFU.
@@ -316,14 +316,14 @@ def _bench_mfu(jax, is_tpu: bool):
     bq, bk = resolved_block_sizes(L)
     flash_info = {"flash_used": True, "flash_block_q": bq, "flash_block_k": bk}
     try:
-        step, params, opt_state, toks = build(use_flash=True)
+        step, params, opt_state, toks, model = build(use_flash=True)
         params, opt_state, loss = step(params, opt_state, toks)  # compile probe
     except Exception as e:
         flash_info = {
             "flash_used": False,
             "flash_error": f"{type(e).__name__}: {str(e)[:300]}",
         }
-        step, params, opt_state, toks = build(use_flash=False)
+        step, params, opt_state, toks, model = build(use_flash=False)
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
 
@@ -355,7 +355,44 @@ def _bench_mfu(jax, is_tpu: bool):
 
     achieved = model_flops_per_step * steps / dt
     hfu = (hw_flops_per_step * steps / dt / peak) if hw_flops_per_step else 0.0
+    if os.environ.get("BENCH_BREAKDOWN"):
+        # where the non-MFU time goes (round-2 verdict #2): compare the
+        # full train step against fwd-only and fwd+bwd programs on the
+        # same model, so the optimizer/loss shares are on record
+        flash_info["breakdown_ms"] = _mfu_breakdown(
+            jax, model, params, toks, steps, dt / steps
+        )
     return achieved / peak, achieved / 1e12, hfu, flash_info
+
+
+def _mfu_breakdown(jax, model, params, toks, steps, step_s):
+    """{fwd, fwd_bwd, full_step} avg ms — the step's composition."""
+    import optax
+
+    @jax.jit
+    def fwd(p, t):
+        return model.apply(p, t)
+
+    @jax.jit
+    def fwd_bwd(p, t):
+        def lf(pp):
+            logits = model.apply(pp, t)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]
+            ).mean()
+
+        return jax.value_and_grad(lf)(p)
+
+    out = {"full_step": round(step_s * 1e3, 3)}
+    for name, fn in (("fwd", fwd), ("fwd_bwd", fwd_bwd)):
+        r = fn(params, toks)  # compile
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = fn(params, toks)
+        jax.block_until_ready(r)
+        out[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
+    return out
 
 
 def _persist_tpu_result(out: dict):
@@ -470,7 +507,17 @@ def main():
         }
         out.update(flash_info)
         if init_errors:
-            out["init_errors"] = init_errors
+            # a 20-min poll window can log dozens of probe attempts; keep
+            # the JSON line readable (first/last few + count)
+            if len(init_errors) > 6:
+                out["init_errors"] = (
+                    init_errors[:3]
+                    + [f"... {len(init_errors) - 6} more attempts ..."]
+                    + init_errors[-3:]
+                )
+                out["init_attempts"] = len(init_errors)
+            else:
+                out["init_errors"] = init_errors
         if is_tpu:
             # TPU evidence must survive the tunnel dying again: persist
             # into benchmarks/results.json and best-effort commit it
