@@ -295,13 +295,9 @@ def _bench_mfu(jax, is_tpu: bool):
 
         @jax.jit
         def step(params, opt_state, toks):
-            def lf(p):
-                logits = model.apply(p, toks)
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], toks[:, 1:]
-                ).mean()
-
-            loss, grads = jax.value_and_grad(lf)(params)
+            loss, grads = jax.value_and_grad(
+                lambda p: _mfu_loss(model, p, toks)
+            )(params)
             updates, opt_state2 = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state2, loss
 
@@ -358,16 +354,30 @@ def _bench_mfu(jax, is_tpu: bool):
     if os.environ.get("BENCH_BREAKDOWN"):
         # where the non-MFU time goes (round-2 verdict #2): compare the
         # full train step against fwd-only and fwd+bwd programs on the
-        # same model, so the optimizer/loss shares are on record
-        flash_info["breakdown_ms"] = _mfu_breakdown(
-            jax, model, params, toks, steps, dt / steps
-        )
+        # same model. Diagnostic only — it must never cost the already-
+        # measured headline (e.g. the fwd-only logits can OOM a tight chip)
+        try:
+            flash_info["breakdown_ms"] = _mfu_breakdown(
+                jax, model, params, toks, steps, dt / steps
+            )
+        except Exception as e:
+            flash_info["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return achieved / peak, achieved / 1e12, hfu, flash_info
+
+
+def _mfu_loss(model, params, toks):
+    """THE loss of the MFU step — single definition shared by the timed
+    train step and the breakdown programs so they can't diverge."""
+    import optax
+
+    logits = model.apply(params, toks)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], toks[:, 1:]
+    ).mean()
 
 
 def _mfu_breakdown(jax, model, params, toks, steps, step_s):
     """{fwd, fwd_bwd, full_step} avg ms — the step's composition."""
-    import optax
 
     @jax.jit
     def fwd(p, t):
@@ -375,13 +385,7 @@ def _mfu_breakdown(jax, model, params, toks, steps, step_s):
 
     @jax.jit
     def fwd_bwd(p, t):
-        def lf(pp):
-            logits = model.apply(pp, t)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], t[:, 1:]
-            ).mean()
-
-        return jax.value_and_grad(lf)(p)
+        return jax.value_and_grad(lambda pp: _mfu_loss(model, pp, t))(p)
 
     out = {"full_step": round(step_s * 1e3, 3)}
     for name, fn in (("fwd", fwd), ("fwd_bwd", fwd_bwd)):
@@ -508,14 +512,14 @@ def main():
         out.update(flash_info)
         if init_errors:
             # a 20-min poll window can log dozens of probe attempts; keep
-            # the JSON line readable (first/last few + count)
+            # the JSON line readable (first/last few + a uniform count)
+            out["init_attempts"] = len(init_errors)
             if len(init_errors) > 6:
                 out["init_errors"] = (
                     init_errors[:3]
                     + [f"... {len(init_errors) - 6} more attempts ..."]
                     + init_errors[-3:]
                 )
-                out["init_attempts"] = len(init_errors)
             else:
                 out["init_errors"] = init_errors
         if is_tpu:

@@ -395,6 +395,98 @@ class TestNodeElastic:
         )
         assert a.nnodes == (1, 4)
 
+    def test_below_min_retries_within_grace_then_fatal(self, tmp_path):
+        """min_nnodes=2 with only one node present: the agent keeps
+        re-forming through the quorum grace window (peers may be mid-
+        teardown) and only then declares the job fatal — torchelastic
+        waits a join timeout for min nodes the same way."""
+        import time
+
+        from tests._mp_util import free_port
+
+        script = _write(tmp_path, "w.py", "import time; time.sleep(60)\n")
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=1,
+            nnodes=2,
+            min_nnodes=2,  # quorum of 2; only one agent will exist
+            node_rank=0,
+            master_port=free_port(),
+            monitor_interval_s=0.05,
+            node_settle_s=0.2,
+            heartbeat_timeout_s=1.0,
+            quorum_grace_s=2.0,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        agent = LocalElasticAgent(spec)
+        t0 = time.monotonic()
+        res = agent.run()
+        elapsed = time.monotonic() - t0
+        assert res.state is WorkerState.FAILED
+        # it kept retrying for ~the grace window, not instant-fatal
+        assert elapsed >= 2.0, elapsed
+        # and never started workers below quorum
+        assert not agent._workers
+
+    def test_stale_join_key_is_dropped_not_looping(self, tmp_path):
+        """A join key from a crashed joiner (stale timestamp) must be
+        garbage-collected by the leader, not trigger endless re-forms."""
+        import threading
+        import time
+
+        from tests._mp_util import free_port
+
+        from pytorch_distributed_example_tpu.store import TCPStore
+
+        script = _write(
+            tmp_path,
+            "w.py",
+            """
+            import os, time
+            out = os.environ["OUT_DIR"]
+            open(os.path.join(out,
+                f"gen{os.environ['TDX_RESTART_COUNT']}"), "w").write("1")
+            while not os.path.exists(os.path.join(out, "STOP")):
+                time.sleep(0.02)
+            """,
+        )
+        port = free_port()
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=1,
+            nnodes=2,
+            min_nnodes=1,
+            node_rank=0,
+            master_port=port,
+            monitor_interval_s=0.05,
+            node_settle_s=0.2,
+            heartbeat_timeout_s=1.0,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        agent = LocalElasticAgent(spec)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(r=agent.run()))
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while not (tmp_path / "gen0").exists():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # a "joiner" that died long ago: stale timestamp
+            c = TCPStore("127.0.0.1", port, timeout=20.0)
+            try:
+                c.set("agent/join_node/1", str(time.time() - 3600))
+                time.sleep(1.5)  # several monitor passes
+                # leader dropped the stale key instead of re-forming
+                assert not c.check(["agent/join_node/1"])
+                assert agent.restart_count == 0, "stale join caused a re-form"
+            finally:
+                c.close()
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            t.join(timeout=30)
+        assert result["r"].state is WorkerState.SUCCEEDED
+
 
 class TestElasticTrainingExample:
     """examples/elastic/main.py end to end: real DDP training under the
