@@ -488,6 +488,123 @@ class TestNodeElastic:
         assert result["r"].state is WorkerState.SUCCEEDED
 
 
+class TestElasticChurn:
+    """Randomized kill/join churn against the worker-elastic agent: the
+    gang must re-form after every event and the job must still complete.
+    Catches liveness bugs the targeted shrink/grow tests can't."""
+
+    def test_survives_randomized_churn(self, tmp_path):
+        import random
+        import signal
+        import threading
+        import time
+
+        from tests._mp_util import free_port
+
+        from pytorch_distributed_example_tpu.elastic import request_join
+
+        script = _write(
+            tmp_path,
+            "w.py",
+            f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO!r})
+            from pytorch_distributed_example_tpu.store import TCPStore
+
+            out = os.environ["OUT_DIR"]
+            gen = os.environ["TDX_RESTART_COUNT"]
+            rank = os.environ["RANK"]
+            world = int(os.environ["WORLD_SIZE"])
+            with open(os.path.join(out, f"pid_g{{gen}}_r{{rank}}"), "w") as f:
+                f.write(str(os.getpid()))
+            host, port = os.environ["TDX_AGENT_STORE"].rsplit(":", 1)
+            s = TCPStore(host, int(port), timeout=30.0)
+            s.add(f"gen{{gen}}/in", 1)
+            deadline = time.monotonic() + 30
+            while s.add(f"gen{{gen}}/in", 0) < world:
+                if time.monotonic() > deadline:
+                    sys.exit(5)
+                time.sleep(0.02)
+            with open(os.path.join(out, f"sync_g{{gen}}_r{{rank}}"), "w") as f:
+                f.write(str(world))
+            s.close()
+            while not os.path.exists(os.path.join(out, "STOP")):
+                time.sleep(0.02)
+            """,
+        )
+        port = free_port()
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=4,
+            min_nproc=2,
+            max_restarts=10,
+            monitor_interval_s=0.05,
+            master_port=port,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        agent = LocalElasticAgent(spec)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(r=agent.run()))
+        t.start()
+
+        def gen_world(g):
+            """world recorded by generation g's sync files (per-rank
+            names: a shared file could be read mid-truncation)."""
+            for p in tmp_path.glob(f"sync_g{g}_r*"):
+                txt = p.read_text()
+                if txt:
+                    return int(txt)
+            return None
+
+        def wait_converged(after_gen, expect, timeout=45.0):
+            """The gang must re-form at `expect` within a couple of
+            generations (a churn event racing a re-form can legitimately
+            consume two). Returns the generation that converged."""
+            deadline = time.monotonic() + timeout
+            seen = {}
+            while time.monotonic() < deadline:
+                for g in range(after_gen + 1, after_gen + 3):
+                    w = gen_world(g)
+                    if w is not None:
+                        seen[g] = w
+                        if w == expect:
+                            return g
+                time.sleep(0.05)
+            raise AssertionError(
+                f"no generation after {after_gen} converged to "
+                f"{expect}; saw {seen}, agent gen {agent.restart_count}, "
+                f"active {agent.active_nproc}"
+            )
+
+        rng = random.Random(7)
+        try:
+            deadline = time.monotonic() + 45
+            while gen_world(0) is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            world, gen = 4, 0
+            for _ in range(4):
+                if world > spec.min_nproc and (
+                    world >= spec.nproc_per_node or rng.random() < 0.5
+                ):
+                    # kill a random live worker -> shrink
+                    victim = rng.randrange(world)
+                    pid = int((tmp_path / f"pid_g{gen}_r{victim}").read_text())
+                    os.kill(pid, signal.SIGKILL)
+                    expect = world - 1
+                else:
+                    # join -> grow
+                    request_join("127.0.0.1", port)
+                    expect = world + 1
+                gen = wait_converged(gen, expect)
+                world = expect
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            t.join(timeout=90)
+        assert not t.is_alive()
+        assert result["r"].state is WorkerState.SUCCEEDED, result
+
+
 class TestElasticTrainingExample:
     """examples/elastic/main.py end to end: real DDP training under the
     elastic agent, a worker killed mid-run, the gang re-forms smaller,
