@@ -30,7 +30,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..tensor import DistTensor
-from ..types import ReduceOp, Work
+from ..types import OpType, ReduceOp, Work
 
 DEFAULT_BUCKET_CAP_MB = 25.0  # torch nn/parallel/distributed.py:31
 DEFAULT_FIRST_BUCKET_BYTES = 1024 * 1024  # torch dist._DEFAULT_FIRST_BUCKET_BYTES
@@ -136,6 +136,10 @@ class Reducer:
         self.gradient_as_bucket_view = gradient_as_bucket_view
         self._rebuilt = False
         self._buckets_spec: Optional[List[List[int]]] = None
+        # fused bucket programs: ONE compiled XLA program per bucket spec
+        # (pack + pmean + unpack), keyed by (shapes, dtypes) — collapses
+        # the eager path's concat/allreduce/slice dispatch chain
+        self._fused_progs: dict = {}
         # DDP Logger food (torch logger.hpp:42-90)
         self.stats = {
             "num_buckets": 0,
@@ -187,6 +191,11 @@ class Reducer:
 
         W = self.group.size()
         backend = self.group.backend_impl
+        # fused path ONLY for the plain XLA backend: fake (identity
+        # contract) and wrapper (per-collective verification) backends
+        # must keep receiving every allreduce through their own methods
+        if self.comm_hook is None and getattr(backend, "name", None) == "xla":
+            return self._reduce_fused(leaves, treedef)
         in_flight: List[Bucket] = []
 
         # Dispatch ALL buckets before waiting on any. Honest overlap note
@@ -228,6 +237,93 @@ class Reducer:
             b.pending_work.wait()
             for i, off, ln, shp in zip(b.leaf_indices, b.offsets, b.lengths, b.shapes):
                 new_leaves[i] = b.flat[:, off : off + ln].reshape((W,) + shp)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def _fused_prog(self, idx_list, leaves):
+        """ONE jitted program per bucket spec: pack, mean-allreduce, and
+        unpack in a single XLA dispatch (vs the generic path's
+        concat + backend allreduce + per-leaf slice chain — measured
+        8-30x dispatch tax in benchmarks/reducer_bench.py). The psum
+        still lowers to the same ICI collective; XLA fuses the
+        pack/unpack copies around it."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..backends.xla import AXIS, _shard_map
+
+        W = self.group.size()
+        shapes = tuple(tuple(leaves[i].shape[1:]) for i in idx_list)
+        dtypes = tuple(str(leaves[i].dtype) for i in idx_list)
+        key = (shapes, dtypes)
+        prog = self._fused_progs.get(key)
+        if prog is not None:
+            return prog
+        lengths = [int(np.prod(s)) for s in shapes]
+        mesh = self.group.backend_impl.mesh.jax_mesh
+        from ..types import lower_reduce_op
+
+        # the one op->ICI lowering home (types.py), as the backend uses
+        reduce_flat = _shard_map()(
+            lower_reduce_op(ReduceOp.AVG, AXIS),
+            mesh=mesh,
+            in_specs=P(AXIS),
+            out_specs=P(AXIS),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def prog(*bucket_leaves):
+            flat = jnp.concatenate(
+                [l.reshape(W, -1) for l in bucket_leaves], axis=1
+            )
+            red = reduce_flat(flat)
+            outs, off = [], 0
+            for ln, shp in zip(lengths, shapes):
+                outs.append(red[:, off : off + ln].reshape((W,) + shp))
+                off += ln
+            return tuple(outs)
+
+        self._fused_progs[key] = prog
+        return prog
+
+    def _reduce_fused(self, leaves, treedef):
+        """Fast path for the plain (no comm hook) mean reduction: one
+        dispatch per bucket, all buckets enqueued before any wait."""
+        import jax
+
+        from ..types import ArrayWork
+
+        from types import SimpleNamespace
+
+        W = self.group.size()
+        new_leaves = list(leaves)
+        in_flight = []
+        for bno, idx_list in enumerate(self._buckets_spec):
+            prog = self._fused_prog(idx_list, leaves)
+            bucket_leaves = [leaves[i] for i in idx_list]
+
+            def run(prog=prog, bl=bucket_leaves):
+                outs = prog(*bl)
+                return outs, ArrayWork(outs, OpType.ALLREDUCE, "reduce_bucket")
+
+            # flight-recorder/status must see the BUCKET payload, not the
+            # first leaf (the generic path dispatches the flat buffer)
+            total = sum(
+                int(np.prod(l.shape[1:])) for l in bucket_leaves
+            )
+            payload = SimpleNamespace(
+                shape=(W, total), dtype=bucket_leaves[0].dtype
+            )
+            outs, work = self.group._dispatch(
+                f"reduce_bucket[{bno}]", payload, run
+            )
+            in_flight.append((idx_list, outs, work))
+        for idx_list, outs, work in in_flight:
+            work.wait()
+            for i, o in zip(idx_list, outs):
+                new_leaves[i] = o
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def reduce_dist_tensors(self, grads_dt: List[DistTensor], require_sync: bool = True) -> None:

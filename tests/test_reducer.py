@@ -74,6 +74,21 @@ class TestReducer:
         for i, leaf in enumerate(out):
             np.testing.assert_allclose(np.asarray(leaf), mean_r + i)
 
+    def test_fake_backend_bypasses_fused_path(self, world):
+        """The fused XLA bucket program must NOT hijack other backends:
+        a fake-group Reducer keeps FakeBackend's no-communication
+        identity contract (regression: the fused gate once matched every
+        backend via hasattr(mesh))."""
+        W = world.size()
+        g = tdx.new_group(backend="fake")
+        grads = {
+            "a": self._rank_stacked(W, (4,), lambda r: np.full((4,), r)),
+        }
+        out = Reducer(process_group=g).reduce(grads)
+        # identity: every rank's slot still holds ITS value, not the mean
+        for r in range(W):
+            np.testing.assert_allclose(np.asarray(out["a"])[r], float(r))
+
     def test_no_sync_skips(self, world):
         W = world.size()
         grads = [self._rank_stacked(W, (5,), lambda r: np.full((5,), r))]
