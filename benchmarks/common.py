@@ -1,8 +1,10 @@
-"""Shared benchmark harness helpers (JSON-line emission)."""
+"""Shared benchmark harness helpers (JSON-line emission + persistence)."""
 
 from __future__ import annotations
 
 import json
+import os
+import time
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0, **extra):
@@ -15,3 +17,36 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0, **extra
     rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec
+
+
+def persist_result(name: str, record: dict) -> None:
+    """Merge one bench record into benchmarks/results.json.
+
+    The TPU tunnel flaps (round 2/3 lesson): any bench that succeeds on
+    real hardware should leave durable machine-readable evidence even if
+    the operator ran it one-off rather than through run_all. Same schema
+    run_all writes; merging preserves other jobs' entries."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "benchmarks", "results.json")
+    doc = {"results": {}}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):  # tolerate a torn/foreign file
+                doc = loaded
+        except Exception:
+            pass
+    doc.setdefault("results", {})
+    doc["results"][name] = {"rc": 0, "result": record}
+    doc["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def on_tpu() -> bool:
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "") or ""
+    return d.platform.lower() in ("tpu", "axon") or "tpu" in kind.lower()

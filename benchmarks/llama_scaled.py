@@ -46,7 +46,7 @@ CFG_8B = dict(
 )
 
 
-def _build(cfg_kw, seq, bf16_params, use_flash):
+def _build(cfg_kw, seq, bf16_params, use_flash, remat=True):
     import jax
     import jax.numpy as jnp
 
@@ -59,7 +59,7 @@ def _build(cfg_kw, seq, bf16_params, use_flash):
         max_seq_len=seq,
         dtype=jnp.bfloat16,
         use_flash=use_flash,
-        remat=True,
+        remat=remat,
         **cfg_kw,
     )
     model = TransformerLM(cfg)
@@ -86,9 +86,11 @@ def run_mfu(args):
 
     from benchmarks.common import emit
 
+    from benchmarks.common import on_tpu
+
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
-    if dev.platform.lower() not in ("tpu", "axon") and "tpu" not in kind.lower():
+    if not on_tpu():
         emit(
             "llama_scaled_mfu",
             0.0,
@@ -102,7 +104,11 @@ def run_mfu(args):
 
     peak = _peak_flops(kind)
     B, L = args.batch, args.seq
-    model, cfg = _build(CFG_1B, L, True, use_flash=not args.no_flash)
+    # remat trades MFU for memory; ~1B bf16 states (~7.6 GB) may leave
+    # room to skip it on a 16 GB chip — try --no-remat on hardware
+    model, cfg = _build(
+        CFG_1B, L, True, use_flash=not args.no_flash, remat=not args.no_remat
+    )
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, L)), jnp.int32
     )
@@ -138,7 +144,7 @@ def run_mfu(args):
 
     flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)
     mfu = flops / dt / peak if peak else 0.0
-    emit(
+    rec = emit(
         "llama_scaled_mfu",
         round(mfu, 4),
         "mfu",
@@ -148,8 +154,12 @@ def run_mfu(args):
         step_ms=round(dt * 1e3, 1),
         batch=B,
         seq=L,
+        remat=not args.no_remat,
         device_kind=kind,
     )
+    from benchmarks.common import persist_result
+
+    persist_result("llama_scaled_mfu", rec)  # TPU-only path: keep it
 
 
 def run_memory8b(args):
@@ -306,6 +316,9 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--no-flash", action="store_true")
+    ap.add_argument("--no-remat", action="store_true",
+                    help="mfu mode: skip per-block remat (more HBM, "
+                         "higher MFU if it fits)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=None)
     args = ap.parse_args()

@@ -248,7 +248,6 @@ class Reducer:
         pack/unpack copies around it."""
         import jax
         import jax.numpy as jnp
-        from jax import lax
         from jax.sharding import PartitionSpec as P
 
         from ..backends.xla import AXIS, _shard_map
