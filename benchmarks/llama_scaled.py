@@ -22,6 +22,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -159,7 +160,10 @@ def run_mfu(args):
     )
     from benchmarks.common import persist_result
 
-    persist_result("llama_scaled_mfu", rec)  # TPU-only path: keep it
+    # TPU-only path. TDX_MFU_KEY_SUFFIX lets the watcher keep the
+    # pre-bake and tuned-blocks runs as separate evidence rows.
+    suffix = os.environ.get("TDX_MFU_KEY_SUFFIX", "")
+    persist_result("llama_scaled_mfu" + suffix, rec)
 
 
 def run_memory8b(args):
@@ -175,12 +179,39 @@ def run_memory8b(args):
 
     import optax
 
-    n_dev = len(jax.devices())
+    # --target tpu: AOT-compile against a DEVICELESS TPU topology
+    # (jax.experimental.topologies) so XLA's *TPU* backend does the
+    # scheduling — its temp_size honors the per-block remat and the
+    # flash kernel, unlike the CPU backend's (round-3 VERDICT #6). Works
+    # with no TPU attached: the PJRT TPU compiler runs on the host.
+    target = args.target
+    topo_devices = None
+    if target in ("tpu", "auto"):
+        try:
+            from jax.experimental import topologies
+
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name=args.topology
+            )
+            topo_devices = list(topo.devices)
+            target = "tpu"
+        except Exception as e:
+            if target == "tpu":
+                raise
+            print(f"# tpu topology unavailable ({type(e).__name__}: "
+                  f"{str(e)[:200]}); falling back to attached devices",
+                  file=sys.stderr)
+            target = "cpu"
+
+    pool = topo_devices if topo_devices is not None else jax.devices()
+    n_dev = len(pool)
     fsdp = args.fsdp or n_dev // args.tp
-    devs = np.array(jax.devices()[: fsdp * args.tp]).reshape(fsdp, args.tp)
+    devs = np.array(pool[: fsdp * args.tp]).reshape(fsdp, args.tp)
     mesh = Mesh(devs, ("fsdp", "tp"))
 
-    model, cfg = _build(CFG_8B, args.seq, True, use_flash=False)
+    # Flash attention is the real TPU path; the CPU target can't compile
+    # the Mosaic kernel, so it falls back to dense (the old caveat).
+    model, cfg = _build(CFG_8B, args.seq, True, use_flash=(target == "tpu"))
     toks_abs = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32)
     abs_params = jax.eval_shape(
         lambda r: model.init(r, jnp.zeros((1, args.seq), jnp.int32)),
@@ -284,8 +315,33 @@ def run_memory8b(args):
         + 4 * b_loc * args.seq * cfg.d_model * 2 * 6  # one block live (qkv/ffn)
         + 2 * b_loc * args.seq * cfg.vocab_size * 4 // max(args.tp, 1)
     )
-    total = state_per_dev + act
-    emit(
+    extra = {}
+    if target == "tpu" and "temp_size_in_bytes" in mem:
+        # The TPU backend's schedule IS the real accounting: temp covers
+        # grads + activations + collective buffers with remat and flash
+        # honored. Per-device peak = live arguments + temps (donated
+        # outputs alias into arguments).
+        total = mem["argument_size_in_bytes"] + mem["temp_size_in_bytes"]
+        extra["accounting"] = "xla_tpu_backend"
+        extra["activation_bytes_per_device_analytic_crosscheck"] = int(act)
+    else:
+        total = state_per_dev + act
+        extra["accounting"] = "state_xla + activations_analytic"
+        extra["activation_bytes_per_device_analytic"] = int(act)
+        if target == "tpu":
+            # TPU compile ran but memory_analysis failed: the row falls
+            # back to the analytic estimate and says so (and is NOT
+            # persisted as backend-verified evidence below)
+            extra["tpu_memory_analysis_failed"] = mem.get(
+                "memory_analysis_error", "temp_size_in_bytes missing"
+            )
+        else:
+            extra["cpu_temp_caveat"] = (
+                "temp_size is the CPU backend's schedule (dense attention, "
+                "remat not honored by its buffer liveness); TPU uses "
+                "flash+remat — run with --target tpu for the real accounting"
+            )
+    rec = emit(
         "llama_scaled_memory8b",
         round(total / 1e9, 3),
         "GB/device",
@@ -293,19 +349,25 @@ def run_memory8b(args):
         mesh={"fsdp": fsdp, "tp": args.tp},
         seq=args.seq,
         batch=args.batch,
+        target=target,
+        topology=(args.topology if target == "tpu" else None),
+        flash=(target == "tpu"),
         compile_s=round(compile_s, 1),
         state_bytes_per_device_xla_verified=int(state_per_dev),
-        activation_bytes_per_device_analytic=int(act),
         xla_memory_analysis=mem,
-        cpu_temp_caveat=(
-            "temp_size is the CPU backend's schedule (dense attention, "
-            "remat not honored by its buffer liveness); TPU uses "
-            "flash+remat — see activation_bytes_per_device_analytic"
-        ),
         analytic=analytic,
         fits_16gb_hbm=bool(total < 16e9),  # v5e/v5 lite class
         fits_32gb_hbm=bool(total < 32e9),  # v4-8 class (32 GB/chip)
+        **extra,
     )
+    if target == "tpu" and extra.get("accounting") == "xla_tpu_backend":
+        # TPU-backend accounting is durable evidence (VERDICT #6) —
+        # persist it like the hardware-measured rows. An analytic
+        # fallback (memory_analysis failed) must NOT be stored under
+        # the backend-verified key.
+        from benchmarks.common import persist_result
+
+        persist_result("llama_scaled_memory8b_tpu", rec)
 
 
 def main():
@@ -321,6 +383,13 @@ def main():
                          "higher MFU if it fits)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=None)
+    ap.add_argument("--target", choices=["auto", "cpu", "tpu"], default="auto",
+                    help="memory8b: 'tpu' AOT-compiles against a deviceless "
+                         "TPU topology (real TPU memory accounting, no "
+                         "hardware needed); 'cpu' uses attached devices")
+    ap.add_argument("--topology", default="v5e:2x4",
+                    help="deviceless TPU topology (v5e:2x4 = 8 chips x "
+                         "16 GB; also e.g. v4:2x2x2)")
     args = ap.parse_args()
     if args.mode == "mfu":
         args.batch = args.batch or 8

@@ -300,6 +300,25 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.lru_cache(maxsize=1)
+def _tuned_table() -> dict:
+    """Checked-in block-size tuning table, measured on real TPU hardware
+    by `benchmarks/flash_bench.py` and baked by
+    `benchmarks/bake_flash_defaults.py` (the cuDNN-heuristic pattern:
+    sweep once per geometry on hardware, ship the winners). Keys are
+    "L{seq}" plus "default"; absent/unreadable file = empty table."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "flash_tuned.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except Exception:
+        return {}
+
+
 def resolved_block_sizes(
     L: int,
     block_q: Optional[int] = None,
@@ -307,15 +326,21 @@ def resolved_block_sizes(
 ) -> tuple:
     """The effective (block_q, block_k) `flash_attention` will use for a
     given sequence length: per-call override, else `TDX_FLASH_BLOCK_Q` /
-    `TDX_FLASH_BLOCK_K` env, else 128, each clamped to L. Callers that
-    gate on divisibility (e.g. models.transformer._flash_ok) must check
-    against THESE, not the hard-coded default."""
+    `TDX_FLASH_BLOCK_K` env, else the hardware-tuned table
+    (`flash_tuned.json`: exact-L entry, then "default"), else 128, each
+    clamped to L. Callers that gate on divisibility (e.g.
+    models.transformer._flash_ok) must check against THESE, not the
+    hard-coded default."""
     import os
 
+    tuned = _tuned_table()
+    row = tuned.get(f"L{L}") or tuned.get("default") or {}
     if block_q is None:
-        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 128))
+        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 0)) or \
+            int(row.get("block_q", 0)) or 128
     if block_k is None:
-        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 128))
+        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 0)) or \
+            int(row.get("block_k", 0)) or 128
     return min(block_q, L), min(block_k, L)
 
 
