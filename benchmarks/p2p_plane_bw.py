@@ -1,0 +1,187 @@
+"""Direct p2p data-plane bandwidth vs the store funnel (round-3 VERDICT #3).
+
+Measures end-to-end GB/s of multiproc `send`/`recv` across two real
+processes on BOTH routes the runtime can take:
+
+* plane: the direct per-pair TCP data plane (`p2p.py`) — gloo's
+  full-mesh pair-connection design (ProcessGroupGloo.hpp:48+);
+* store: the chunked rank-0 store-daemon funnel (the fallback/control
+  path, measured at ~0.2 GB/s in round 3).
+
+Both routes are driven through the SAME `dist._store_send`/`_store_recv`
+entry points the public API uses, with the plane installed or not — so
+the numbers are the runtime's real dispatch, not a synthetic socket
+loop.
+
+Usage: python benchmarks/p2p_plane_bw.py [--sizes-mb 1,16,64] [--iters 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+_CHILD = """
+import os, sys, time
+sys.path.insert(0, {root!r})
+import numpy as np
+from pytorch_distributed_example_tpu import distributed as dist
+from pytorch_distributed_example_tpu.store import TCPStore, PrefixStore
+from pytorch_distributed_example_tpu.p2p import P2PPlane
+
+store = TCPStore("127.0.0.1", int(sys.argv[1]), timeout=120.0)
+mode = sys.argv[4]
+
+class G:
+    def __init__(self):
+        self.store, self.timeout = store, 120.0
+        self.group_name = "bw"
+    def rank(self): return 0
+    def size(self): return 2
+    def get_global_rank(self, r): return r
+    def get_group_rank(self, r): return r
+
+g = G()
+if mode == "plane":
+    dist._p2p_plane = P2PPlane(
+        0, PrefixStore("p2pbw", store), advertise="127.0.0.1"
+    ).start()
+sizes = [int(s) for s in sys.argv[2].split(",")]
+iters = int(sys.argv[3])
+store.set("child_ready", b"1")
+for size in sizes:
+    val = np.empty(size // 4, np.float32)
+    store.wait([f"go/{{size}}"], 120.0)
+    for _ in range(iters):
+        dist._store_send(val, 1, g, 0)
+store.wait(["all_done"], 120.0)  # keep plane sockets alive until drained
+if dist._p2p_plane is not None:
+    dist._p2p_plane.close()
+store.close()
+"""
+
+
+def run_mode(mode: str, sizes, iters: int, emit):
+    import numpy as np  # noqa: F401
+
+    from pytorch_distributed_example_tpu import distributed as dist
+    from pytorch_distributed_example_tpu.p2p import P2PPlane
+    from pytorch_distributed_example_tpu.store import PrefixStore, TCPStore
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    store = TCPStore("127.0.0.1", 0, is_master=True, timeout=120.0)
+
+    class G:
+        def __init__(self):
+            self.store, self.timeout = store, 120.0
+            self.group_name = "bw"
+
+        def rank(self):
+            return 1
+
+        def size(self):
+            return 2
+
+        def get_global_rank(self, r):
+            return r
+
+        def get_group_rank(self, r):
+            return r
+
+    g = G()
+    plane = None
+    if mode == "plane":
+        plane = P2PPlane(
+            1, PrefixStore("p2pbw", store), advertise="127.0.0.1"
+        ).start()
+        dist._p2p_plane = plane
+    else:
+        dist._p2p_plane = None
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            _CHILD.format(root=root),
+            str(store.port),
+            ",".join(str(s) for s in sizes),
+            str(iters),
+            mode,
+        ],
+        env={**os.environ},
+    )
+    rows = []
+    try:
+        store.wait(["child_ready"], 120.0)
+        for size in sizes:
+            store.set(f"go/{size}", b"1")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                dist._store_recv(None, 0, g, 0, 120.0)
+            dt = (time.perf_counter() - t0) / iters
+            rows.append(
+                emit(
+                    f"p2p_{mode}_bw_{size >> 20}MB",
+                    size / dt / 1e9,
+                    "GB/s",
+                    bytes=size,
+                    us=round(dt * 1e6, 1),
+                )
+            )
+        store.set("all_done", b"1")
+    finally:
+        try:
+            child.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait(timeout=10)
+        finally:
+            if plane is not None:
+                plane.close()
+            dist._p2p_plane = None
+            store.close()
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,16,64")
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--modes", default="plane,store")
+    args = ap.parse_args()
+
+    from benchmarks.common import emit
+
+    sizes = [int(float(s) * (1 << 20)) for s in args.sizes_mb.split(",")]
+    out = {}
+    for mode in args.modes.split(","):
+        out[mode] = run_mode(mode, sizes, args.iters, emit)
+    if "plane" in out and "store" in out:
+        pairs = {
+            r["metric"].rsplit("_", 1)[-1]: [r["value"]]
+            for r in out["plane"]
+        }
+        for r in out["store"]:
+            pairs.setdefault(r["metric"].rsplit("_", 1)[-1], [0.0]).append(
+                r["value"]
+            )
+        speedups = {
+            k: round(v[0] / v[1], 2) for k, v in pairs.items() if len(v) == 2 and v[1]
+        }
+        emit(
+            "p2p_plane_vs_store",
+            max(speedups.values()) if speedups else 0.0,
+            "x",
+            speedup_by_size=speedups,
+            plane=[{r["metric"]: r["value"]} for r in out["plane"]],
+            store=[{r["metric"]: r["value"]} for r in out["store"]],
+        )
+    return out
+
+
+if __name__ == "__main__":
+    main()

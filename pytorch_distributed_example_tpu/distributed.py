@@ -363,6 +363,21 @@ def init_process_group(
     )
     _world.default_pg = pg
     GroupMember.WORLD = pg
+    if _world.mode == "multiproc":
+        # Direct p2p data plane (gloo's full-mesh pair connections,
+        # ProcessGroupGloo.hpp:48+): every rank publishes a listener
+        # endpoint; tensor bytes then move pair-to-pair instead of
+        # funneling through the store daemon. Must run on EVERY rank —
+        # an opted-out rank publishes "none" so peers take the store
+        # fallback instead of blocking on the endpoint key.
+        global _p2p_plane
+        from . import p2p as _p2p_mod
+
+        _p2p_plane = _p2p_mod.P2PPlane(
+            _world.process_rank,
+            PrefixStore(f"p2p_plane_gen{_world.scope}", store),
+            enabled=os.environ.get("TDX_P2P_PLANE", "1") != "0",
+        ).start()
     _install_rank_excepthook()
     return pg
 
@@ -449,10 +464,16 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
     the store; the daemon host waits (bounded) for all marks before the
     daemon goes down.
     """
-    global _world
+    global _world, _p2p_plane
     if group is None or group is _world.default_pg or group is GroupMember.WORLD:
         for pg in _world.pg_map.values():
             pg.backend_impl.shutdown()
+        if _p2p_plane is not None:
+            # before the store teardown handshake: in-flight plane frames
+            # never touch the store, and waiters must wake with a clear
+            # "closed" error rather than a store connection error
+            _p2p_plane.close()
+            _p2p_plane = None
         st = _world.store
         if st is not None:
             if _world.mode == "multiproc" and _world.default_pg is not None:
@@ -1157,6 +1178,39 @@ def _p2p_chunk_bytes() -> int:
     return int(os.environ.get("TDX_P2P_CHUNK_BYTES", str(4 << 20)))
 
 
+# Direct data plane (p2p.py). Routing is deterministic per incarnation:
+# a sender uses the plane iff the DESTINATION published a listener; a
+# receiver drains its own inbox iff ITS listener is up — the same
+# condition from both ends, so a message never has two possible paths.
+_p2p_plane = None
+
+
+def _route_key(g: ProcessGroup) -> str:
+    # group+incarnation scope, mirroring the store path's PrefixStore
+    # nesting: same (tag, seq) on two groups must not collide.
+    return f"{_world.scope}/{g.group_name}"
+
+
+def _plane_send_target(g: ProcessGroup, dst_group_rank: int, timeout: float):
+    """(plane, dst_global) when the plane carries this send, else None.
+
+    The routing invariant both ends rely on: a message takes the store
+    path ONLY when dst published a "none" endpoint (its listener is
+    down), which is exactly when dst drains the store. A failed endpoint
+    LOOKUP must therefore propagate — silently diverting one message to
+    the store would strand it (a listening receiver never polls the
+    store) and desynchronize the pair's sequence counters."""
+    if _p2p_plane is None:
+        return None
+    dst_global = g.get_global_rank(dst_group_rank)
+    ep = _p2p_plane.endpoint_of(dst_global, timeout)
+    return (_p2p_plane, dst_global) if ep is not None else None
+
+
+def _plane_recv_active() -> bool:
+    return _p2p_plane is not None and _p2p_plane.listening
+
+
 def _store_send(tensor, dst: int, g: ProcessGroup, tag: int) -> None:
     """Multiproc send: serialize this process's tensor into the store under
     a generation- and group-scoped per-(dst, tag) sequence key — the
@@ -1168,6 +1222,11 @@ def _store_send(tensor, dst: int, g: ProcessGroup, tag: int) -> None:
     seq = ctr.get((dst, tag), 0)
     ctr[(dst, tag)] = seq + 1
     val = np.asarray(tensor.local_numpy()[0] if isinstance(tensor, DistTensor) else tensor)
+    target = _plane_send_target(g, dst, g.timeout)
+    if target is not None:
+        plane, dst_global = target
+        plane.send(dst_global, _route_key(g), tag, seq, val, g.timeout)
+        return
     key = _p2p_key(_world.scope, me, dst, tag, seq)
     payload = pickle.dumps(val)
     chunk = _p2p_chunk_bytes()
@@ -1186,6 +1245,14 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
     ctr = _p2p_counters(g, "recv")
     seq = ctr.get((src, tag), 0)
     ctr[(src, tag)] = seq + 1
+    if _plane_recv_active():
+        # my listener is up, so every peer routed this message through it
+        val = _p2p_plane.recv(
+            g.get_global_rank(src), _route_key(g), tag, seq, timeout
+        )
+        if isinstance(tensor, np.ndarray):
+            tensor[...] = val
+        return val
     key = _p2p_key(_world.scope, src, me, tag, seq)
     g.store.wait([key], timeout)
     head = g.store.get(key)
@@ -1222,6 +1289,16 @@ def _store_recv_any(tensor, g: ProcessGroup, tag: int, timeout: float):
     me = g.rank()
     ctr = _p2p_counters(g, "recv")
     peers = [r for r in range(g.size()) if r != me]
+    if _plane_recv_active():
+        cands = [(g.get_global_rank(r), ctr.get((r, tag), 0)) for r in peers]
+        src_global, val = _p2p_plane.recv_any(
+            cands, _route_key(g), tag, timeout if timeout is not None else 3600.0
+        )
+        src = g.get_group_rank(src_global)
+        ctr[(src, tag)] = ctr.get((src, tag), 0) + 1
+        if isinstance(tensor, np.ndarray):
+            tensor[...] = val
+        return src, val
     budget = timeout if timeout is not None else 3600.0
     deadline = time.monotonic() + budget
     poll = 0.002

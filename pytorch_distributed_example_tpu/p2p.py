@@ -1,0 +1,328 @@
+"""Direct peer-to-peer TCP data plane for multiproc p2p.
+
+Round-3 VERDICT #3: every p2p byte used to funnel through the rank-0
+store daemon (~0.2 GB/s, one epoll loop shared by all pairs). gloo gives
+each rank pair its own TCP connection (`ProcessGroupGloo.hpp:48+`
+full-mesh contexts, rendezvoused through the store); this module is that
+design for the multiproc runtime:
+
+* each process runs one listener; its `(host, port)` endpoint is
+  published in the store ONCE per world incarnation (the only store
+  traffic this plane ever generates);
+* a sender lazily opens a per-peer connection on first send and streams
+  frames over it — tensor bytes move process-to-process, never through
+  the daemon;
+* receives land in an in-memory inbox keyed `(src, route, tag, seq)`,
+  matching the store path's sequencing exactly, so `send`/`recv`/
+  `recv(src=None)`/`batch_isend_irecv` keep their semantics unchanged;
+* a rank whose listener cannot come up (or that sets `TDX_P2P_PLANE=0`)
+  publishes a "none" endpoint and peers fall back to the store path for
+  messages TO it — the store remains the control plane and the fallback
+  data plane.
+
+Wire format, per connection: one hello (`<I` sender global rank), then
+frames of `[<I header_len][pickled header][payload bytes]` where header
+is `(route, tag, seq, kind, dtype, shape, payload_len)`. numpy arrays
+ship as raw buffers (`kind="nd"`, zero pickling of the bulk bytes);
+everything else falls back to pickle (`kind="pkl"`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HELLO = struct.Struct("<I")
+_HLEN = struct.Struct("<I")
+_NONE_EP = b"none"
+# Socket buffer sizes are left to kernel autotuning: explicit
+# SO_SNDBUF/SO_RCVBUF pins the window and measured ~2x slower on
+# loopback than autotuned buffers. Override via TDX_P2P_SOCK_BUF if a
+# DCN path needs a fixed window.
+_SOCK_BUF = int(os.environ.get("TDX_P2P_SOCK_BUF", "0"))
+_RECV_CHUNK = 8 << 20
+
+
+def _advertise_host() -> str:
+    """The address peers should dial. Explicit override, else the
+    rendezvous host heuristic: if the master address is loopback the
+    whole gang is on this machine; otherwise use this host's name."""
+    adv = os.environ.get("TDX_P2P_ADVERTISE")
+    if adv:
+        return adv
+    master = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    if master in ("127.0.0.1", "localhost", "::1", ""):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def encode(val) -> Tuple[str, str, tuple, object]:
+    """(kind, dtype, shape, buffer) — numpy bulk bytes raw, rest pickled."""
+    if isinstance(val, np.ndarray) and val.dtype != object:
+        arr = np.ascontiguousarray(val)
+        # byte-cast view: len() must be NBYTES (the wire length), not
+        # the element count arr.data would report
+        return "nd", str(arr.dtype), arr.shape, memoryview(arr).cast("B")
+    payload = pickle.dumps(val)
+    return "pkl", "", (), payload
+
+
+def decode(kind: str, dtype: str, shape: tuple, buf) -> object:
+    if kind == "nd":
+        # buf is the bytearray the reader filled -> writable array view
+        return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+    return pickle.loads(bytes(buf))
+
+
+class PlaneClosed(RuntimeError):
+    pass
+
+
+class P2PPlane:
+    """One per process per world incarnation.
+
+    `store` must be scoped to the incarnation (the caller wraps the world
+    store in a PrefixStore) so endpoints from a dead generation are never
+    dialed. All ranks MUST construct a plane (enabled or not): the
+    endpoint key doubles as the routing decision peers wait on.
+    """
+
+    def __init__(
+        self,
+        my_rank: int,
+        store,
+        enabled: bool = True,
+        bind_host: str = "",
+        advertise: Optional[str] = None,
+    ):
+        self.rank = int(my_rank)
+        self.store = store
+        self.enabled = enabled
+        self.bind_host = bind_host
+        self.advertise = advertise or _advertise_host()
+        self.listening = False
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._readers: List[threading.Thread] = []
+        self._in_conns: List[socket.socket] = []
+        self._out: Dict[int, socket.socket] = {}
+        self._out_locks: Dict[int, threading.Lock] = {}
+        self._out_guard = threading.Lock()
+        self._ep_cache: Dict[int, Optional[Tuple[str, int]]] = {}
+        self._inbox: Dict[tuple, tuple] = {}
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "P2PPlane":
+        """Bind the listener (if enabled) and publish the endpoint."""
+        ep = _NONE_EP
+        if self.enabled:
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                if _SOCK_BUF:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF)
+                s.bind((self.bind_host, 0))
+                s.listen(64)
+                self._listener = s
+                self.listening = True
+                port = s.getsockname()[1]
+                ep = pickle.dumps((self.advertise, port))
+                t = threading.Thread(
+                    target=self._accept_loop,
+                    name=f"tdx-p2p-accept-r{self.rank}",
+                    daemon=True,
+                )
+                t.start()
+                self._accept_thread = t
+            except OSError:
+                self.listening = False  # publish "none"; peers fall back
+        self.store.set(f"ep/{self.rank}", ep)
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        for s in [self._listener] + list(self._out.values()) + self._in_conns:
+            if s is not None:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self.listening = False
+
+    # -- endpoints ---------------------------------------------------------
+
+    def endpoint_of(self, dst: int, timeout: float) -> Optional[Tuple[str, int]]:
+        """(host, port) of dst's listener, or None if dst opted out.
+        Blocks until dst has PUBLISHED (every rank publishes in
+        init_process_group, so this resolves as soon as dst initializes)."""
+        if dst in self._ep_cache:
+            return self._ep_cache[dst]
+        key = f"ep/{dst}"
+        self.store.wait([key], timeout)
+        raw = self.store.get(key)
+        ep = None if raw == _NONE_EP else tuple(pickle.loads(raw))
+        self._ep_cache[dst] = ep
+        return ep
+
+    # -- send --------------------------------------------------------------
+
+    def _peer_lock(self, dst: int) -> threading.Lock:
+        with self._out_guard:
+            return self._out_locks.setdefault(dst, threading.Lock())
+
+    def _connect_locked(self, dst: int, ep: Tuple[str, int], timeout: float) -> socket.socket:
+        """Cached-or-new connection to dst. Caller holds dst's peer lock."""
+        s = self._out.get(dst)
+        if s is not None:
+            return s
+        s = socket.create_connection(ep, timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if _SOCK_BUF:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+        s.settimeout(None)
+        s.sendall(_HELLO.pack(self.rank))
+        self._out[dst] = s
+        return s
+
+    def send(self, dst: int, route: str, tag: int, seq: int, val, timeout: float) -> None:
+        """Stream one message to dst's inbox. Caller has already checked
+        `endpoint_of(dst)` is not None (else it takes the store path).
+
+        A connection failure mid-stream is FATAL for the pair (gloo
+        semantics: a broken pair connection fails the op) — TCP gives no
+        delivery acknowledgement, so a silent reconnect-and-resend could
+        skip a frame the kernel buffered but never delivered, leaving
+        the receiver's (src, tag) sequence permanently off-by-one. The
+        elastic layer owns recovery: a re-formed gang builds a fresh
+        plane in a new incarnation."""
+        if self._closed:
+            raise PlaneClosed("p2p plane closed")
+        ep = self.endpoint_of(dst, timeout)
+        if ep is None:
+            raise RuntimeError(f"rank {dst} has no p2p listener (store path only)")
+        kind, dtype, shape, buf = encode(val)
+        header = pickle.dumps((route, tag, seq, kind, dtype, shape, len(buf)))
+        with self._peer_lock(dst):  # frame atomicity per connection
+            s = self._connect_locked(dst, ep, timeout)
+            try:
+                s.sendall(_HLEN.pack(len(header)) + header)
+                s.sendall(buf)
+            except OSError as e:
+                self._out.pop(dst, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                raise RuntimeError(
+                    f"p2p connection to rank {dst} failed mid-send "
+                    f"(route={route} tag={tag} seq={seq}): {e}"
+                ) from e
+
+    # -- receive -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                hello = self._read_exact(conn, _HELLO.size)
+            except (OSError, EOFError):
+                conn.close()
+                continue
+            (src,) = _HELLO.unpack(hello)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._in_conns.append(conn)
+            t = threading.Thread(
+                target=self._reader,
+                args=(conn, src),
+                name=f"tdx-p2p-read-r{self.rank}-from{src}",
+                daemon=True,
+            )
+            t.start()
+            self._readers.append(t)
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int):
+        # np.empty, not bytearray: bytearray(64MB) zero-fills — a whole
+        # extra pass over memory per message on the hot path
+        buf = np.empty(n, np.uint8)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], min(n - got, _RECV_CHUNK))
+            if r == 0:
+                raise EOFError
+            got += r
+        return buf
+
+    def _reader(self, conn: socket.socket, src: int) -> None:
+        try:
+            while True:
+                (hlen,) = _HLEN.unpack(self._read_exact(conn, _HLEN.size))
+                route, tag, seq, kind, dtype, shape, plen = pickle.loads(
+                    bytes(self._read_exact(conn, hlen))
+                )
+                payload = self._read_exact(conn, plen)
+                with self._cond:
+                    self._inbox[(src, route, tag, seq)] = (kind, dtype, shape, payload)
+                    self._cond.notify_all()
+        except (OSError, EOFError):
+            pass  # peer closed; pending messages already delivered
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def recv(self, src: int, route: str, tag: int, seq: int, timeout: float):
+        got = self._wait([(src, route, tag, seq)], timeout)
+        return decode(*got[1])
+
+    def recv_any(
+        self, candidates: List[Tuple[int, int]], route: str, tag: int, timeout: float
+    ) -> Tuple[int, object]:
+        """candidates = [(src, next_expected_seq)] — first message to
+        arrive from any of them wins (torch recv(src=None))."""
+        keys = [(src, route, tag, seq) for src, seq in candidates]
+        key, body = self._wait(keys, timeout)
+        return key[0], decode(*body)
+
+    def _wait(self, keys: List[tuple], timeout: float) -> Tuple[tuple, tuple]:
+        deadline = time.monotonic() + (timeout if timeout is not None else 3600.0)
+        with self._cond:
+            while True:
+                for k in keys:
+                    body = self._inbox.pop(k, None)
+                    if body is not None:
+                        return k, body
+                if self._closed:
+                    raise PlaneClosed("p2p plane closed while receiving")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"p2p recv: nothing from {sorted({k[0] for k in keys})} "
+                        f"within {timeout}s"
+                    )
+                self._cond.wait(min(remaining, 0.5))
