@@ -64,7 +64,8 @@ class Accuracy:
 class Trainer:
     """fit/train/evaluate — the reference's Trainer [RECONSTRUCTED]."""
 
-    def __init__(self, ddp, optimizer, train_data, test_data, batch_size, world_size, rng):
+    def __init__(self, ddp, optimizer, train_data, test_data, batch_size,
+                 world_size, rng, num_workers=0, worker_mode="thread"):
         import jax
         import optax
         from pytorch_distributed_example_tpu.data import DataLoader, DistributedSampler
@@ -95,7 +96,9 @@ class Trainer:
             for r in range(world_size)
         ]
         self.loaders = [
-            DataLoader(train_data, batch_size, sampler=s) for s in self.samplers
+            DataLoader(train_data, batch_size, sampler=s,
+                       num_workers=num_workers, worker_mode=worker_mode)
+            for s in self.samplers
         ]
         self.test_data = test_data
 
@@ -170,6 +173,12 @@ def main():
     p.add_argument("--momentum", type=float, default=0.5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--root", type=str, default=None, help="MNIST IDX data dir")
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="loader workers per rank (the reference CLI's flag)")
+    p.add_argument("--worker-mode", choices=["thread", "process"],
+                   default="thread",
+                   help="process = torch-style worker processes with a "
+                        "shared-memory return path (GIL-bound decode)")
     args = p.parse_args()
 
     import jax
@@ -194,7 +203,9 @@ def main():
     optimizer = optax.sgd(args.lr, momentum=args.momentum)
 
     trainer = Trainer(ddp, optimizer, train_data, test_data,
-                      args.batch_size, world, rng)
+                      args.batch_size, world, rng,
+                      num_workers=args.num_workers,
+                      worker_mode=args.worker_mode)
     trainer.fit(args.epochs)
     tdx.destroy_process_group()
 

@@ -3,14 +3,23 @@
 Plays the role torch's DataLoader plays in the reference's training loop
 (SURVEY.md §3.3): iterate sampler indices, gather into contiguous numpy
 batches. `num_workers > 0` overlaps batch ASSEMBLY with the train step
-the way torch's worker processes do — a thread pool fetches upcoming
-batches while the accelerator runs, `prefetch_factor` bounding how far
-ahead it reads (threads, not processes: the fetch work is numpy gather
-and IO, which release the GIL, and the heavy compute lives on the
-device). Order is always the sampler's order. Device transfer still
-happens once per step in the train loop (`jax.device_put` of the global
-batch with the dp sharding), keeping host→HBM traffic to exactly one
-copy per step.
+the way torch's worker processes do, in one of two worker models:
+
+* ``worker_mode="thread"`` (default): a thread pool. Right for
+  numpy-gather and IO fetch work, which release the GIL while the heavy
+  compute lives on the device.
+* ``worker_mode="process"``: real worker processes with a shared-memory
+  return path (`worker_pool.py`) — torch's `num_workers` design
+  (torch/utils/data/dataloader.py), for Python-heavy per-sample decode
+  that the GIL serializes in threads (measured ceiling 1.33x;
+  benchmarks/results.json loader_scaling). Deterministic dispatch and
+  per-(epoch, worker) seeding; `get_worker_info()` works inside
+  workers.
+
+`prefetch_factor` bounds how far ahead either model reads. Order is
+always the sampler's order. Device transfer still happens once per step
+in the train loop (`jax.device_put` of the global batch with the dp
+sharding), keeping host→HBM traffic to exactly one copy per step.
 """
 
 from __future__ import annotations
@@ -32,9 +41,13 @@ class DataLoader:
         num_workers: int = 0,
         prefetch_factor: int = 2,
         collate_fn: Optional[Callable] = None,
+        worker_mode: str = "thread",
+        worker_init_fn: Optional[Callable] = None,
     ):
         if num_workers < 0 or prefetch_factor < 1:
             raise ValueError("num_workers >= 0 and prefetch_factor >= 1")
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler
@@ -44,7 +57,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.collate_fn = collate_fn
+        self.worker_mode = worker_mode
+        self.worker_init_fn = worker_init_fn
         self._epoch = 0
+        self._pool = None  # lazily-started ProcessPool, reused across epochs
 
     def _indices(self):
         if self.sampler is not None:
@@ -72,7 +88,37 @@ class DataLoader:
             for idx in self._batches(indices):
                 yield self._fetch(idx)
             return
+        if self.worker_mode == "process":
+            yield from self._iter_process(indices)
+            return
         yield from self._iter_prefetch(indices)
+
+    def _iter_process(self, indices):
+        from .worker_pool import ProcessPool
+
+        if self._pool is None:
+            self._pool = ProcessPool(
+                self.dataset,
+                self.num_workers,
+                self.prefetch_factor,
+                self.collate_fn,
+                self.worker_init_fn,
+                self.seed,
+            )
+        epoch = self._epoch  # _indices() already advanced it for shuffle
+        yield from self._pool.run_epoch(epoch, list(self._batches(indices)))
+
+    def shutdown(self) -> None:
+        """Stop process-mode workers (no-op otherwise). Also runs on GC."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
     def _iter_prefetch(self, indices):
         """Fetch up to num_workers batches concurrently, keeping at most

@@ -1,0 +1,168 @@
+"""Process-mode DataLoader workers (round-3 VERDICT #4).
+
+torch's DataLoader forks worker processes with a shared-memory return
+path (torch/utils/data/dataloader.py `num_workers`); these tests pin
+that contract for `worker_mode="process"`: sampler-order delivery,
+deterministic dispatch + per-(epoch, worker) seeding, worker_init_fn /
+get_worker_info, error propagation naming the worker, non-array batch
+fallback, and pool reuse across epochs.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu.data import DataLoader, get_worker_info
+from pytorch_distributed_example_tpu.data.worker_pool import seed_for
+
+
+class _ArrDS:
+    def __init__(self, n=256):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        return np.asarray(idx, np.float32) * 2.0, np.asarray(idx, np.int32)
+
+
+class _RngDS(_ArrDS):
+    def __getitem__(self, idx):
+        wi = get_worker_info()
+        assert wi is not None, "get_worker_info() None inside worker"
+        return np.random.rand(len(idx)).astype(np.float32), np.asarray(idx, np.int32)
+
+
+class _NestDS(_ArrDS):
+    def __getitem__(self, idx):
+        return {
+            "x": np.asarray(idx, np.float32),
+            "pair": (np.ones((len(idx), 2), np.int8), [np.zeros(1, np.float64)]),
+        }
+
+
+class _ObjDS(_ArrDS):
+    def __getitem__(self, idx):
+        return {"ids": [int(i) for i in idx]}, "meta"
+
+
+class _BadDS(_ArrDS):
+    def __getitem__(self, idx):
+        raise ValueError("decode exploded")
+
+
+@pytest.fixture
+def shutdown():
+    loaders = []
+    yield loaders.append
+    for ld in loaders:
+        ld.shutdown()
+
+
+def test_order_and_values_across_epochs(shutdown):
+    dl = DataLoader(_ArrDS(), batch_size=32, num_workers=3, worker_mode="process")
+    shutdown(dl)
+    for _ in range(2):  # pool persists; epoch 2 reuses it
+        xs = np.concatenate([x for x, _ in dl])
+        assert np.array_equal(xs, np.arange(256, dtype=np.float32) * 2.0)
+
+
+def test_uneven_last_batch_and_drop_last(shutdown):
+    dl = DataLoader(_ArrDS(250), batch_size=32, num_workers=2, worker_mode="process")
+    shutdown(dl)
+    batches = [x for x, _ in dl]
+    assert len(batches) == 8 and len(batches[-1]) == 250 - 7 * 32
+    dl2 = DataLoader(
+        _ArrDS(250), batch_size=32, num_workers=2, worker_mode="process",
+        drop_last=True,
+    )
+    shutdown(dl2)
+    assert all(len(x) == 32 for x, _ in dl2)
+
+
+def test_worker_rng_deterministic_across_runs(shutdown):
+    outs = []
+    for _ in range(2):
+        dl = DataLoader(
+            _RngDS(), batch_size=32, num_workers=2, worker_mode="process", seed=3
+        )
+        shutdown(dl)
+        outs.append(np.concatenate([x for x, _ in dl]))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_epochs_get_distinct_rng_streams(shutdown):
+    dl = DataLoader(
+        _RngDS(), batch_size=32, num_workers=2, worker_mode="process", seed=3,
+        shuffle=True,  # advances epoch counter -> new worker seeds
+    )
+    shutdown(dl)
+    e0 = np.concatenate([x for x, _ in dl])
+    e1 = np.concatenate([x for x, _ in dl])
+    assert not np.array_equal(e0, e1)
+    assert seed_for(3, 0, 0, 2) != seed_for(3, 1, 0, 2)
+
+
+def test_worker_init_fn_runs_in_worker(shutdown):
+    import os as _os
+
+    parent = _os.getpid()
+    seen = []
+
+    def init(worker_id):
+        # runs in the CHILD: pid differs from the parent's
+        assert _os.getpid() != parent
+        seen.append(worker_id)  # worker-local list; stays empty here
+
+    dl = DataLoader(
+        _ArrDS(64), batch_size=32, num_workers=2, worker_mode="process",
+        worker_init_fn=init,
+    )
+    shutdown(dl)
+    list(dl)
+    assert seen == []  # proves init ran in the child, not here
+
+
+def test_nested_batch_structures_roundtrip(shutdown):
+    dl = DataLoader(_NestDS(64), batch_size=32, num_workers=2, worker_mode="process")
+    shutdown(dl)
+    out = list(dl)
+    assert np.array_equal(out[0]["x"], np.arange(32, dtype=np.float32))
+    pair = out[0]["pair"]
+    assert pair[0].dtype == np.int8 and pair[1][0].dtype == np.float64
+
+
+def test_non_array_batches_fall_back_to_pickle(shutdown):
+    dl = DataLoader(_ObjDS(64), batch_size=64, num_workers=2, worker_mode="process")
+    shutdown(dl)
+    (payload, meta), = list(dl)
+    assert payload["ids"][:3] == [0, 1, 2] and meta == "meta"
+
+
+def test_abandoned_iteration_does_not_leak_into_next(shutdown):
+    """Early `break` leaves in-flight results; the next iteration must
+    not consume them as its own batches (stale-run discard)."""
+    dl = DataLoader(
+        _ArrDS(), batch_size=16, num_workers=2, worker_mode="process",
+        prefetch_factor=2, shuffle=True, seed=11,
+    )
+    shutdown(dl)
+    for x, _ in dl:  # abandon with W*P results still in flight
+        break
+    ref = DataLoader(_ArrDS(), batch_size=16, shuffle=True, seed=11)
+    next(iter(ref))  # burn epoch 0 so both loaders are at epoch 1
+    got = np.concatenate([x for x, _ in dl])
+    want = np.concatenate([x for x, _ in ref])
+    assert np.array_equal(got, want)
+
+
+def test_worker_error_propagates_with_traceback():
+    dl = DataLoader(_BadDS(), batch_size=32, num_workers=2, worker_mode="process")
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        list(dl)
+    dl.shutdown()
+
+
+def test_bad_worker_mode_rejected():
+    with pytest.raises(ValueError, match="worker_mode"):
+        DataLoader(_ArrDS(), batch_size=8, worker_mode="greenlet")
