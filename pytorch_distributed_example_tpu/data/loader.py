@@ -60,6 +60,7 @@ class DataLoader:
         self.worker_mode = worker_mode
         self.worker_init_fn = worker_init_fn
         self._epoch = 0
+        self._plain_epochs = 0  # per-__iter__ counter (no-sampler, no-shuffle)
         self._pool = None  # lazily-started ProcessPool, reused across epochs
 
     def _indices(self):
@@ -105,7 +106,16 @@ class DataLoader:
                 self.worker_init_fn,
                 self.seed,
             )
-        epoch = self._epoch  # _indices() already advanced it for shuffle
+        # The reseed epoch: the sampler's set_epoch() value when one is
+        # attached (the DistributedSampler training pattern), else the
+        # shuffle counter _indices() advanced, else a plain per-__iter__
+        # counter — so the per-(epoch, worker) seeding contract fires on
+        # EVERY path, not only sampler-less shuffle.
+        if self.sampler is not None and hasattr(self.sampler, "epoch"):
+            epoch = int(self.sampler.epoch)
+        else:
+            epoch = self._epoch if self.shuffle else self._plain_epochs
+            self._plain_epochs += 1
         yield from self._pool.run_epoch(epoch, list(self._batches(indices)))
 
     def shutdown(self) -> None:

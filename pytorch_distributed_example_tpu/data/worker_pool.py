@@ -141,11 +141,17 @@ def _worker_main(
     # worker_init_fn runs ONCE per worker lifetime (torch's contract,
     # incl. persistent_workers=True) — per-epoch re-invocation would
     # leak any connections/mmaps it opens. Only the RESEED is per-epoch.
-    seed0 = seed_for(base_seed, 0, worker_id, num_workers)
-    _WORKER_INFO = WorkerInfo(worker_id, num_workers, seed0, 0)
-    np.random.seed(seed0)
-    if worker_init_fn is not None:
-        worker_init_fn(worker_id)
+    # A startup failure must still reach the parent WITH its traceback
+    # (run tag 0 = fatal, any iteration), not as a bare dead-worker.
+    try:
+        seed0 = seed_for(base_seed, 0, worker_id, num_workers)
+        _WORKER_INFO = WorkerInfo(worker_id, num_workers, seed0, 0)
+        np.random.seed(seed0)
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+    except Exception:
+        result_q.put((0, -1, worker_id, -1, ("err", traceback.format_exc())))
+        return
     cur_epoch = 0
     try:
         while True:
@@ -317,6 +323,8 @@ class ProcessPool:
                         f"DataLoader worker(s) {dead} exited unexpectedly"
                     ) from None
                 continue
+            if r == 0:  # worker startup failure: fatal in any run
+                self._materialize(wid, body)  # raises with the traceback
             if r != run:
                 continue  # leftover from an abandoned iteration
             received[seq] = self._materialize(wid, body)

@@ -59,6 +59,28 @@ class GroupMember:
     NON_GROUP_MEMBER = object()
 
 
+class _DispatchMarker:
+    """Watchdog entry that spans a collective from BEFORE dispatch: a
+    synchronously-hung dispatch (fn() blocking on an absent peer) shows
+    up as this marker never completing; once dispatch returns it
+    delegates completion to the real Work."""
+
+    def __init__(self):
+        self._work = None
+        self._abandoned = False
+
+    def bind(self, work) -> None:
+        self._work = work
+
+    def abandon(self) -> None:  # dispatch raised: not a hang
+        self._abandoned = True
+
+    def is_completed(self) -> bool:
+        if self._abandoned:
+            return True
+        return self._work is not None and self._work.is_completed()
+
+
 class ProcessGroup:
     """A set of ranks + their mesh + a concrete backend.
 
@@ -96,6 +118,8 @@ class ProcessGroup:
         (torch NCCL Watchdog parity — SURVEY.md §5.3)."""
         from .utils.watchdog import Watchdog
 
+        if self.watchdog is not None:  # replacing: never leak a scanner
+            self.watchdog.stop()
         self.watchdog = Watchdog(
             timeout_s=timeout_s if timeout_s is not None else self.timeout, **kw
         ).start()
@@ -129,15 +153,27 @@ class ProcessGroup:
         self.status.record_enqueue(seq, op_name, numel)
         rec = global_recorder()
         rec.record(seq, op_name, self.group_name, shape, dtype, numel)
+        # Register with the watchdog BEFORE dispatch: unlike NCCL's
+        # always-async enqueue, a CPU-gloo / synchronous-execution
+        # collective can BLOCK inside fn() when a peer never joins — a
+        # post-dispatch registration would never happen and the hang
+        # would be invisible. The marker counts from now and delegates
+        # to the real Work once dispatch returns.
+        marker = None
+        if self.watchdog is not None:
+            marker = _DispatchMarker()
+            self.watchdog.register(marker, f"{self.group_name}:{op_name}:{seq}")
         try:
             out, work = fn()
         except Exception:
             # a raised collective is a failure, not a hang: mark it so the
             # flight recorder / status don't show it as forever-enqueued
+            if marker is not None:
+                marker.abandon()
             rec.complete(seq, self.group_name, failed=True)
             raise
-        if self.watchdog is not None:
-            self.watchdog.register(work, f"{self.group_name}:{op_name}:{seq}")
+        if marker is not None:
+            marker.bind(work)
 
         fired = []
 
@@ -378,8 +414,45 @@ def init_process_group(
             PrefixStore(f"p2p_plane_gen{_world.scope}", store),
             enabled=os.environ.get("TDX_P2P_PLANE", "1") != "0",
         ).start()
+    # both modes: default ON under the elastic agent, TDX_WATCHDOG=1
+    # opts in anywhere (driver mode included — a wedged ICI collective
+    # should dump + abort there too, not sit on the 30-min PG timeout)
+    _maybe_enable_default_watchdog(pg)
     _install_rank_excepthook()
     return pg
+
+
+def _maybe_enable_default_watchdog(pg: ProcessGroup) -> None:
+    """Hang-to-recovery composition (round-3 VERDICT #5): under an
+    elastic agent, a worker wedged inside a collective (peer lost
+    mid-op) must not stall the gang until the 30-min PG timeout — the
+    watchdog dumps the flight recorder and ABORTS the process, the
+    agent observes the death and re-forms the gang, training resumes
+    from checkpoint. This is exactly torch's NCCL-watchdog →
+    torchelastic composition (ProcessGroupNCCL.hpp:676 abort →
+    elastic/agent/server/api.py:952 restart).
+
+    Default ON when launched by the elastic agent (TDX_AGENT_STORE in
+    the env), opt-in/out anywhere via TDX_WATCHDOG=1/0; the trip
+    timeout TDX_WATCHDOG_TIMEOUT_S (default 300 s) must stay well under
+    the PG timeout and far above the slowest healthy collective."""
+    default = "1" if "TDX_AGENT_STORE" in os.environ else "0"
+    if os.environ.get("TDX_WATCHDOG", default) == "0":
+        return
+    timeout_s = float(os.environ.get("TDX_WATCHDOG_TIMEOUT_S", "300"))
+
+    def _abort(desc: str, work, dump_path: str) -> None:
+        print(
+            f"[rank {_world.process_rank}] watchdog: collective "
+            f"{desc!r} exceeded {timeout_s}s; flight recorder dumped to "
+            f"{dump_path or '<disabled>'}; aborting so the elastic agent "
+            "can re-form the gang",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(int(os.environ.get("TDX_WATCHDOG_EXIT_CODE", "3")))
+
+    pg.enable_watchdog(timeout_s=timeout_s, on_timeout=_abort)
 
 
 def _new_group_internal(
@@ -467,6 +540,12 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
     global _world, _p2p_plane
     if group is None or group is _world.default_pg or group is GroupMember.WORLD:
         for pg in _world.pg_map.values():
+            if pg.watchdog is not None:
+                # a scanner outliving its generation could os._exit a
+                # healthy process minutes after teardown (its Works
+                # never complete once the backend is gone)
+                pg.watchdog.stop()
+                pg.watchdog = None
             pg.backend_impl.shutdown()
         if _p2p_plane is not None:
             # before the store teardown handshake: in-flight plane frames
@@ -496,6 +575,9 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
         _world = _WorldState()
         GroupMember.WORLD = None
     else:
+        if group.watchdog is not None:
+            group.watchdog.stop()
+            group.watchdog = None
         group.backend_impl.shutdown()
         _world.pg_map.pop(group.group_name, None)
 

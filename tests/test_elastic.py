@@ -662,6 +662,96 @@ class TestElasticTrainingExample:
         assert result["r"].restarts >= 1, result
 
 
+class TestHangRecovery:
+    """Watchdog → elastic composition (round-3 VERDICT #5): a worker
+    wedged inside a collective must be aborted by the in-process
+    watchdog (flight-recorder dump + nonzero exit), after which the
+    agent re-forms the gang and training resumes from checkpoint —
+    torch's ProcessGroupNCCL.hpp:676 watchdog abort composed with
+    elastic/agent/server/api.py:952 restart."""
+
+    WORKER = """
+    import json, os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    import numpy as np
+    import pytorch_distributed_example_tpu as tdx
+
+    out = os.environ["OUT_DIR"]
+    tdx.init_process_group(backend="xla", init_method="env://")
+    rank, world = tdx.get_rank(), tdx.get_world_size()
+    gen = int(os.environ["TDX_RESTART_COUNT"])
+    # the elastic-agent default wiring, not a test-local setup:
+    assert tdx.distributed._get_default_group().watchdog is not None, \\
+        "watchdog not enabled by default under the elastic agent"
+
+    ckpt = os.path.join(out, "ckpt.json")
+    start = 0
+    if os.path.exists(ckpt):
+        with open(ckpt) as f:
+            start = json.load(f)["step"]
+
+    TARGET, HANG_AT = 10, 5
+    for step in range(start, TARGET):
+        if gen == 0 and rank == 1 and step == HANG_AT:
+            # a WEDGED peer: stops participating but does not exit —
+            # exactly the failure the PG timeout would otherwise sit on
+            with open(os.path.join(out, "wedged.txt"), "w") as f:
+                f.write("1")
+            time.sleep(3600)
+        t = tdx.DistTensor.from_process_local(
+            np.array([float(step)], np.float32)
+        )
+        tdx.all_reduce(t)
+        val = float(t.local_numpy()[0][0])
+        assert val == step * world, (val, step, world)
+        if rank == 0:
+            with open(ckpt + ".tmp", "w") as f:
+                json.dump({"step": step + 1}, f)
+            os.replace(ckpt + ".tmp", ckpt)
+    tdx.destroy_process_group()
+    with open(os.path.join(out, f"done_r{rank}_g{gen}.txt"), "w") as f:
+        f.write(str(start))
+    """
+
+    def test_hung_collective_aborts_and_gang_recovers(self, tmp_path):
+        import glob
+        import json
+
+        script = _write(tmp_path, "hangworker.py", self.WORKER)
+        dumps = tmp_path / "dumps"
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=2,
+            max_restarts=2,
+            monitor_interval_s=0.1,
+            env={
+                "OUT_DIR": str(tmp_path),
+                "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                "XLA_FLAGS": "",
+                # must beat the hang quickly but sit ABOVE this slow
+                # box's first-compile time for the collective program
+                "TDX_WATCHDOG_TIMEOUT_S": "6",
+                "TDX_DEBUG_DIR": str(dumps),
+            },
+        )
+        res = LocalElasticAgent(spec, log_dir=str(tmp_path / "logs")).run()
+        # the gang recovered and finished the full step target
+        assert res.state is WorkerState.SUCCEEDED, res
+        assert res.restarts >= 1, "no restart: the hang was never detected"
+        with open(tmp_path / "ckpt.json") as f:
+            assert json.load(f)["step"] == 10
+        # generation 1 resumed FROM THE CHECKPOINT, not from scratch
+        assert (tmp_path / "done_r0_g1.txt").read_text() == "5"
+        assert (tmp_path / "wedged.txt").exists()
+        # the aborting rank dumped the flight recorder naming the hang
+        dump_files = glob.glob(str(dumps / "tdx_flight_*.json"))
+        assert dump_files, "watchdog did not dump the flight recorder"
+        reasons = [json.load(open(p)).get("reason", "") for p in dump_files]
+        assert any("watchdog timeout" in r for r in reasons), reasons
+
+
 class TestRunCLI:
     def test_tpurun_end_to_end(self, tmp_path):
         script = _write(

@@ -166,3 +166,37 @@ def test_worker_error_propagates_with_traceback():
 def test_bad_worker_mode_rejected():
     with pytest.raises(ValueError, match="worker_mode"):
         DataLoader(_ArrDS(), batch_size=8, worker_mode="greenlet")
+
+
+def _failing_init(worker_id):
+    raise OSError("init-kaboom")
+
+
+def test_worker_init_fn_failure_propagates_with_traceback():
+    dl = DataLoader(
+        _ArrDS(64), batch_size=32, num_workers=2, worker_mode="process",
+        worker_init_fn=_failing_init,
+    )
+    with pytest.raises(RuntimeError, match="init-kaboom"):
+        list(dl)
+    dl.shutdown()
+
+
+def test_sampler_epoch_drives_worker_reseed(shutdown):
+    """The DistributedSampler pattern (sampler.set_epoch per epoch) must
+    advance the worker RNG seeds — the contract the mnist example uses."""
+    from pytorch_distributed_example_tpu.data import DistributedSampler
+
+    ds = _RngDS(64)
+    s = DistributedSampler(ds, num_replicas=1, rank=0, shuffle=False)
+    dl = DataLoader(ds, batch_size=32, sampler=s, num_workers=2,
+                    worker_mode="process")
+    shutdown(dl)
+    s.set_epoch(0)
+    e0 = np.concatenate([x for x, _ in dl])
+    s.set_epoch(1)
+    e1 = np.concatenate([x for x, _ in dl])
+    assert not np.array_equal(e0, e1), "set_epoch did not reseed workers"
+    s.set_epoch(0)
+    e0b = np.concatenate([x for x, _ in dl])
+    assert np.array_equal(e0, e0b), "same epoch must reproduce the stream"
