@@ -1,0 +1,281 @@
+"""Deviceless TPU-target AOT checks: compile evidence + roofline MFU
+ceilings without a reachable chip (round-3 VERDICT #2's "committed
+ceiling analysis" alternative, producible while the tunnel is down).
+
+The PJRT TPU compiler runs fine on the host against a compile-only
+topology (jax.experimental.topologies), so three things become
+checkable with zero TPU hardware:
+
+1. The flash-attention Pallas kernel COMPILES for the TPU target at
+   every candidate block size (so a short real-hardware window never
+   burns time on candidates Mosaic rejects).
+2. The MFU bench steps (headline 512d/8L and the ~1B llama config)
+   compile for one v5e chip, with XLA's own cost model (FLOPs, bytes
+   accessed) and memory analysis recorded.
+3. A ROOFLINE CEILING for each step: the step cannot run faster than
+   max(hw_flops/peak_flops, bytes/hbm_bw) seconds, so
+   mfu_ceiling = model_flops / (time_lb * peak_flops). Also the remat
+   recompute tax: hw_flops(remat)/hw_flops(no remat).
+
+All rows are persisted with evidence="aot_compile_only" — these are
+compiler facts, not measurements; the watcher's real-hardware runs
+overwrite nothing here and vice versa.
+
+Usage: python benchmarks/tpu_aot_check.py   (CPU-pins itself)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# Public spec-sheet numbers (cloud.google.com/tpu docs): bf16 peak
+# FLOP/s and HBM bandwidth per chip, keyed by device_kind substring.
+_CHIP_SPECS = {
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v3": (123e12, 900e9),
+}
+
+
+def _specs(kind: str):
+    kind = kind.lower()
+    for key, spec in _CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    return (197e12, 819e9)  # default to the v5e class this repo targets
+
+
+def _single_device():
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(
+        platform="tpu",
+        topology_name=os.environ.get("TDX_AOT_TOPO", "v5e:2x2"),
+    )
+    return topo.devices[0]
+
+
+def _cost(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0)), float(ca.get("bytes accessed", 0.0))
+
+
+def _mem(compiled):
+    ma = compiled.memory_analysis()
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0]
+    return {
+        "argument_size_in_bytes": int(ma.argument_size_in_bytes),
+        "output_size_in_bytes": int(ma.output_size_in_bytes),
+        "temp_size_in_bytes": int(ma.temp_size_in_bytes),
+        "alias_size_in_bytes": int(ma.alias_size_in_bytes),
+    }
+
+
+def _compile_train_step(dev, cfg_kw, L, B, use_flash, remat):
+    """AOT-compile a full bf16 train step (fwd+bwd+adamw) for one chip."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import SingleDeviceSharding
+
+    from benchmarks.llama_scaled import _build
+
+    model, cfg = _build(cfg_kw, L, True, use_flash=use_flash, remat=remat)
+    sharding = SingleDeviceSharding(dev)
+
+    toks_abs = jax.ShapeDtypeStruct((B, L), jnp.int32, sharding=sharding)
+    abs_params = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, L), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )
+    abs_params = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16, sharding=sharding),
+        abs_params,
+    )
+    opt = optax.adamw(1e-3)
+    abs_opt = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sharding),
+        jax.eval_shape(opt.init, abs_params),
+    )
+
+    def step(params, opt_state, toks):
+        def lf(p):
+            logits = model.apply(p, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), toks[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    t0 = time.perf_counter()
+    compiled = (
+        jax.jit(step, donate_argnums=(0, 1))
+        .lower(abs_params, abs_opt, toks_abs)
+        .compile()
+    )
+    n_params = sum(
+        int(l.size) for l in jax.tree_util.tree_leaves(abs_params)
+    )
+    return compiled, n_params, cfg, time.perf_counter() - t0
+
+
+def _ceiling_row(name, dev, cfg_kw, L, B, persist):
+    from benchmarks.common import emit, persist_result
+    from benchmarks.llama_scaled import _analytic_flops
+
+    peak_flops, hbm_bw = _specs(dev.device_kind)
+    rows = {}
+    for remat in (True, False):
+        try:
+            compiled, n_params, cfg, compile_s = _compile_train_step(
+                dev, cfg_kw, L, B, use_flash=True, remat=remat
+            )
+            hw_flops, bytes_acc = _cost(compiled)
+            rows["remat" if remat else "no_remat"] = {
+                "hw_flops": hw_flops,
+                "bytes_accessed": bytes_acc,
+                "memory": _mem(compiled),
+                "compile_s": round(compile_s, 1),
+                "n_params": n_params,
+            }
+        except Exception as e:
+            rows["remat" if remat else "no_remat"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"
+            }
+    ok = {k: v for k, v in rows.items() if "hw_flops" in v}
+    if not ok:
+        rec = emit(name, 0.0, "mfu_ceiling", error="no variant compiled",
+                   variants=rows)
+        return rec
+    model_flops = _analytic_flops(
+        next(iter(ok.values()))["n_params"],
+        cfg_kw["n_layers"], cfg_kw["d_model"], L, B * L,
+    )
+    ceilings = {}
+    for k, v in ok.items():
+        time_lb = max(v["hw_flops"] / peak_flops,
+                      v["bytes_accessed"] / hbm_bw)
+        ceilings[k] = {
+            "mfu_ceiling": round(model_flops / (time_lb * peak_flops), 4),
+            "bound": (
+                "compute" if v["hw_flops"] / peak_flops
+                >= v["bytes_accessed"] / hbm_bw else "memory"
+            ),
+            "arithmetic_intensity": round(
+                v["hw_flops"] / max(v["bytes_accessed"], 1), 1
+            ),
+            "hw_vs_model_flops": round(v["hw_flops"] / model_flops, 3),
+        }
+    best = max(c["mfu_ceiling"] for c in ceilings.values())
+    rec = emit(
+        name,
+        best,
+        "mfu_ceiling",
+        evidence="aot_compile_only",
+        device_kind=dev.device_kind,
+        peak_bf16_flops=peak_flops,
+        hbm_bytes_per_s=hbm_bw,
+        model_flops_per_step=model_flops,
+        batch=B,
+        seq=L,
+        ceilings=ceilings,
+        variants=rows,
+        caveat=(
+            "roofline upper bound from XLA cost analysis (flops + bytes "
+            "accessed); real MFU sits below it — overlap, dispatch and "
+            "non-roofline ops are not modeled"
+        ),
+    )
+    if persist:
+        persist_result(name, rec)
+    return rec
+
+
+def _flash_matrix(dev):
+    """Compile-check every candidate block size for the TPU target."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    from benchmarks.common import emit, persist_result
+    from pytorch_distributed_example_tpu.ops.flash_attention import flash_attention
+
+    sharding = SingleDeviceSharding(dev)
+    table = {}
+    for L, dh in ((512, 64), (1024, 128), (2048, 128)):
+        qs = jax.ShapeDtypeStruct((4, L, 8, dh), jnp.bfloat16, sharding=sharding)
+        for b in (128, 256, 512):
+            if L % b:
+                continue
+            key = f"L{L}_dh{dh}_b{b}x{b}"
+            try:
+                t0 = time.perf_counter()
+
+                def fwd(q, k, v, b=b):
+                    return flash_attention(
+                        q, k, v, causal=True, block_q=b, block_k=b,
+                        interpret=False,
+                    )
+
+                def train(q, k, v, b=b):
+                    return jax.grad(
+                        lambda q: fwd(q, k, v, b).astype(jnp.float32).sum()
+                    )(q)
+
+                cf = jax.jit(fwd).lower(qs, qs, qs).compile()
+                ct = jax.jit(train).lower(qs, qs, qs).compile()
+                flops, _ = _cost(ct)
+                table[key] = {
+                    "ok": True,
+                    "compile_s": round(time.perf_counter() - t0, 1),
+                    "train_hw_flops": flops,
+                }
+            except Exception as e:
+                table[key] = {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {str(e)[:200]}",
+                }
+    n_ok = sum(1 for v in table.values() if v.get("ok"))
+    rec = emit(
+        "aot_flash_compile_matrix",
+        n_ok,
+        "configs_compiled",
+        evidence="aot_compile_only",
+        device_kind=dev.device_kind,
+        table=table,
+    )
+    if n_ok:
+        persist_result("aot_flash_compile_matrix", rec)
+    return rec
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["TDX_FLASH_INTERPRET"] = "0"  # Mosaic path for the TPU target
+
+    dev = _single_device()
+    from benchmarks.llama_scaled import CFG_1B
+
+    _flash_matrix(dev)
+    # headline MFU geometry (bench.py): 512d/8L/8h @ L=512 B=8
+    headline = dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=8)
+    _ceiling_row("aot_ceiling_headline_mfu", dev, headline, 512, 8, persist=True)
+    # ~1B single-chip config (llama_scaled --mode mfu): L=1024 B=8
+    _ceiling_row("aot_ceiling_llama1b_mfu", dev, CFG_1B, 1024, 8, persist=True)
+
+
+if __name__ == "__main__":
+    main()

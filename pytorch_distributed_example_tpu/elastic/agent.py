@@ -76,6 +76,20 @@ class WorkerSpec:
     node_settle_s: float = 2.0  # membership settle window per generation
     heartbeat_timeout_s: float = 5.0  # stale-heartbeat node-loss threshold
     quorum_grace_s: float = 60.0  # keep re-forming below min for this long
+    # Rendezvous store FAILOVER (beyond torch parity — torch's rank-0
+    # TCPStore host is a hard SPOF, rendezvous.py:196): every
+    # node-elastic agent runs a cold-standby store daemon and gossips
+    # its endpoint inside heartbeats; when the primary store dies,
+    # survivors walk the cached endpoints in permanent-node-id order
+    # and re-form the gang on the first reachable standby. Store STATE
+    # is not replicated — none is needed, a fresh generation rebuilds
+    # it — only rendezvous capability moves. Note the alignment: the
+    # adopted standby's owner is the lowest surviving node, which is
+    # also group_rank 0, so the jax-coordinator (+1 port) convention
+    # keeps pointing at the host that binds it.
+    store_failover: bool = True  # node-elastic only
+    advertise_addr: Optional[str] = None  # this agent's dialable host
+    failover_grace_s: Optional[float] = None  # default 2x heartbeat timeout
     env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -197,6 +211,17 @@ class LocalElasticAgent:
         self.group_rank: int = spec.node_rank
         self._local_failure = False
         self._quorum_deadline: Optional[float] = None
+        # store failover state: the CURRENTLY adopted rendezvous
+        # endpoint (changes when a standby is promoted), this agent's
+        # cold-standby daemon, and the gossiped peer standby endpoints
+        # (node id -> (host, port)) harvested from fresh heartbeats
+        self._active_master: tuple = (spec.master_addr, spec.master_port)
+        self._standby: Optional[TCPStore] = None
+        self._standby_jax_reserve = None  # bound (port+1) socket, see below
+        self._peer_endpoints: Dict[int, tuple] = {}
+        self._store_host_node = 0  # owner of the ACTIVE store endpoint
+        self._advertise = self._compute_advertise()
+        self.failovers = 0
 
     # -- store hosting -----------------------------------------------------
     def _ensure_store(self) -> Optional[TCPStore]:
@@ -259,8 +284,27 @@ class LocalElasticAgent:
         return port
 
     def _start_workers(self) -> None:
-        store = self._ensure_store()
-        port = store.port if store is not None else self.spec.master_port
+        if self.spec.node_elastic and self._active_master != (
+            self.spec.master_addr, self.spec.master_port
+        ):
+            # a standby was promoted: workers must rendezvous at the
+            # ADOPTED endpoint, not the dead original
+            master_addr, port = self._active_master
+            if (
+                self._store_host_node == self.spec.node_rank
+                and self._standby_jax_reserve is not None
+            ):
+                # release the (port+1) reservation: the rank-0 worker on
+                # THIS host is about to bind it as the jax coordinator
+                try:
+                    self._standby_jax_reserve.close()
+                except OSError:
+                    pass
+                self._standby_jax_reserve = None
+        else:
+            store = self._ensure_store()
+            master_addr = self.spec.master_addr
+            port = store.port if store is not None else self.spec.master_port
         if self.spec.elastic and self.join_endpoint is None:
             # announce the BOUND port: standalone runs use port 0 in the
             # spec, which request_join callers cannot connect to
@@ -302,11 +346,11 @@ class LocalElasticAgent:
                 "TDX_NODE_ID": str(self.spec.node_rank),  # permanent id
                 "LOCAL_WORLD_SIZE": str(nproc),
                 "WORLD_SIZE": str(world),
-                "MASTER_ADDR": self.spec.master_addr,
+                "MASTER_ADDR": master_addr,
                 "MASTER_PORT": str(port),
                 "TDX_RESTART_COUNT": str(self.restart_count),
                 "TORCHELASTIC_RESTART_COUNT": str(self.restart_count),
-                "TDX_AGENT_STORE": f"{self.spec.master_addr}:{port}",
+                "TDX_AGENT_STORE": f"{master_addr}:{port}",
                 # env:// rendezvous must CONNECT to the agent's store, not
                 # bind MASTER_PORT itself (torchelastic's
                 # TORCHELASTIC_USE_AGENT_STORE contract)
@@ -315,7 +359,7 @@ class LocalElasticAgent:
                 # jax multi-controller bring-up: workers (or
                 # init_process_group itself) initialize jax.distributed
                 # against this coordinator (see jax_port selection above)
-                "TDX_JAX_COORDINATOR": f"{self.spec.master_addr}:{jax_port}",
+                "TDX_JAX_COORDINATOR": f"{master_addr}:{jax_port}",
             }
             if self.spec.raw_cmd:
                 argv = list(self.spec.entrypoint)
@@ -517,33 +561,200 @@ class LocalElasticAgent:
     def _hb_key(node: int) -> str:
         return f"agent/hb/node{node}"
 
+    # heartbeat values are "ts|host:standby_port" — the timestamp is the
+    # liveness signal, the endpoint is the standby-store gossip the
+    # failover path dials. Plain-float values (older peers) still parse.
+    @staticmethod
+    def _hb_parse(v: bytes):
+        """(ts, endpoint_or_None); raises ValueError on garbage ts."""
+        s = v.decode()
+        ts_s, _, ep = s.partition("|")
+        ts = float(ts_s)
+        if ep and ":" in ep:
+            host, _, port = ep.rpartition(":")
+            return ts, (host, int(port))
+        return ts, None
+
+    def _compute_advertise(self) -> Optional[str]:
+        """The address peers dial for THIS agent's standby store —
+        computed ONCE (a per-heartbeat DNS lookup would block the
+        monitor loop that doubles as the node-loss detector). None =
+        don't gossip an endpoint at all: on a multi-host gang with
+        broken name resolution, advertising a loopback fallback would
+        hand peers a self-referential address to dial."""
+        if self.spec.advertise_addr:
+            return self.spec.advertise_addr
+        if self.spec.master_addr in ("127.0.0.1", "localhost", "::1"):
+            return "127.0.0.1"  # whole gang on one machine
+        import socket
+
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return None
+
+    def _ensure_standby(self) -> None:
+        if not (self.spec.node_elastic and self.spec.store_failover):
+            return
+        if self._standby is not None or self._advertise is None:
+            return
+        import socket as _socket
+
+        # Also RESERVE standby_port+1: after a promotion the jax
+        # coordinator convention (store port + 1) points there, and an
+        # ephemeral neighbor port is not otherwise guaranteed free. The
+        # reservation socket is released just before this node spawns
+        # workers against its own promoted standby.
+        for _ in range(8):
+            try:
+                st = TCPStore("0.0.0.0", 0, is_master=True, timeout=300.0)
+            except Exception:
+                return  # failover simply unavailable here
+            try:
+                res = _socket.socket()
+                res.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+                res.bind(("", st.port + 1))
+                self._standby = st
+                self._standby_jax_reserve = res
+                return
+            except OSError:
+                try:
+                    st.close()
+                except Exception:
+                    pass  # +1 taken: roll new ephemeral ports
+
     def _heartbeat(self, ctrl) -> None:
         if getattr(self, "_aborted", False):
             return
+        val = str(time.time())
+        if self._standby is not None and self._advertise is not None:
+            me = (self._advertise, self._standby.port)
+            self._peer_endpoints[self.spec.node_rank] = me
+            val += f"|{me[0]}:{me[1]}"
         try:
-            ctrl.set(self._hb_key(self.spec.node_rank), str(time.time()))
+            ctrl.set(self._hb_key(self.spec.node_rank), val)
         except Exception:
             pass  # store host gone; staleness/fatal paths will decide
 
     def _stale_peers(self, ctrl) -> List[int]:
         """Current members whose heartbeat is older than the threshold —
         the node-loss detector (torchelastic learns this from its
-        rendezvous keep-alive the same way)."""
+        rendezvous keep-alive the same way). Fresh heartbeats also feed
+        the standby-endpoint cache the store-failover path dials."""
         now = time.time()
         out = []
         for m in self.members:
             if m == self.spec.node_rank:
                 continue
             v = self._peek(ctrl, self._hb_key(m))
+            fresh = False
             try:
-                fresh = v is not None and (
-                    now - float(v) <= self.spec.heartbeat_timeout_s
-                )
+                if v is not None:
+                    ts, ep = self._hb_parse(v)
+                    fresh = now - ts <= self.spec.heartbeat_timeout_s
+                    if fresh and ep is not None:
+                        self._peer_endpoints[m] = ep
             except ValueError:
                 fresh = False
             if not fresh:
                 out.append(m)
         return out
+
+    def _store_alive(self, endpoint: tuple, timeout: float = 1.5) -> bool:
+        try:
+            probe = TCPStore(
+                endpoint[0], endpoint[1], is_master=False, timeout=timeout
+            )
+            probe.check(["agent/ping"])
+            probe.close()
+            return True
+        except Exception:
+            return False
+
+    def _try_store_failover(self):
+        """Promote a surviving standby store after primary loss.
+
+        Every agent walks the SAME candidate order — current members'
+        gossiped standby endpoints sorted by permanent node id — and
+        adopts the first reachable one, so survivors converge on one
+        endpoint without any out-of-band channel. Two split-brain
+        guards: (a) the primary must stay unreachable for the whole
+        failover grace window (a transiently slow store is not a dead
+        one); (b) a node missing gossip for a LOWER-id member (other
+        than the dead store's own host) refuses to fail over — it
+        cannot rule out that member promoting a standby it has never
+        heard of, and a refused failover just fails THIS agent while
+        the well-informed survivors re-form. Returns the new ctrl
+        handle or None. Store state is NOT carried over: the adopter
+        bumps the generation on the new store and the normal membership
+        machinery re-forms the gang there."""
+        if not (self.spec.node_elastic and self.spec.store_failover):
+            return None
+        if getattr(self, "_aborted", False):
+            return None
+        grace = self.spec.failover_grace_s
+        if grace is None:
+            grace = 2.0 * self.spec.heartbeat_timeout_s
+        deadline = time.monotonic() + grace
+        while True:
+            if self._store_alive(self._active_master):
+                return None  # not a store loss; let the normal paths decide
+            if getattr(self, "_aborted", False):
+                return None
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(min(0.5, self.spec.monitor_interval_s * 2))
+        dead = self._active_master
+        me = self.spec.node_rank
+        for node in sorted(set(self.members) | {me}):
+            ep = self._peer_endpoints.get(node)
+            if ep is None:
+                if node == self._store_host_node:
+                    continue  # the dead host; peers skip or probe it alike
+                if node < me:
+                    return None  # guard (b): incomplete gossip below me
+                continue
+            if ep == dead:
+                continue
+            if node == me:
+                new = self._standby  # adopt OWN standby (daemon handle
+                if new is None:  # doubles as a connected client)
+                    continue
+            else:
+                try:
+                    new = TCPStore(ep[0], ep[1], is_master=False, timeout=2.0)
+                    new.check(["agent/ping"])
+                except Exception:
+                    continue
+            print(
+                f"tpurun[node {me}]: rendezvous store "
+                f"{dead[0]}:{dead[1]} lost; failing over to standby "
+                f"{ep[0]}:{ep[1]} (node {node})",
+                file=sys.stderr,
+            )
+            old = self._ctrl
+            self._ctrl = new
+            self._active_master = ep
+            self._store_host_node = node
+            self.failovers += 1
+            if old is not None and old is not self._standby:
+                try:
+                    old.close()
+                except Exception:
+                    pass
+            if self._store is not None and self._store is not new:
+                try:
+                    self._store.close()
+                except Exception:
+                    pass
+                self._store = None
+            # open the next generation on the NEW store so every
+            # survivor (at different restart counts mid-teardown) meets
+            # at one membership barrier there
+            self._bump_gen(new, self.restart_count + 1)
+            self._heartbeat(new)
+            return new
+        return None
 
     def _peeked_gen(self, ctrl) -> int:
         g = self._peek(ctrl, "agent/restart_gen")
@@ -573,9 +784,14 @@ class LocalElasticAgent:
         out = []
         for n in range(self.spec.nnodes):
             v = self._peek(ctrl, self._hb_key(n))
+            if v is None:
+                continue
             try:
-                if v is not None and now - float(v) <= self.spec.heartbeat_timeout_s:
+                ts, ep = self._hb_parse(v)
+                if now - ts <= self.spec.heartbeat_timeout_s:
                     out.append(n)
+                    if ep is not None:
+                        self._peer_endpoints[n] = ep
             except ValueError:
                 pass
         return out
@@ -732,14 +948,16 @@ class LocalElasticAgent:
                 self._peek(ctrl, f"agent/done/gen{gen}/node{n}") is not None
                 for n in self.members
             ):
-                # two-phase: the store HOST (node 0) must outlive every
-                # peer's observation of the done keys — returning first
-                # would close the daemon under the others' final polls
+                # two-phase: the CURRENT store host (node 0 originally,
+                # the adopted-standby owner after a failover) must
+                # outlive every peer's observation of the done keys —
+                # returning first would close the daemon under the
+                # others' final polls
                 try:
                     ctrl.set(f"agent/done_ack/gen{gen}/node{me}", b"1")
                 except Exception:
                     pass
-                if self.spec.node_rank == 0:
+                if self.spec.node_rank == self._store_host_node:
                     try:
                         ctrl.wait(
                             [
@@ -778,11 +996,21 @@ class LocalElasticAgent:
         ctrl = self._control()
         if ctrl is None:  # unreachable given spec validation (nnodes >= 2)
             raise RuntimeError("node-elastic requires the shared store")
+        self._ensure_standby()
         target = self._peeked_gen(ctrl)
         join_deadline = None
         while True:
             verdict = self._form_membership(ctrl, target)
             if verdict == "fatal":
+                # distinguish "the JOB is fatal" from "the STORE died":
+                # the latter fails over to a surviving standby and
+                # re-forms there (beyond-torch: rank-0 rendezvous host
+                # loss is survivable)
+                new = self._try_store_failover()
+                if new is not None:
+                    ctrl = new
+                    target = max(self._peeked_gen(ctrl), self.restart_count + 1)
+                    continue
                 return RunResult(
                     WorkerState.FAILED, self.restart_count, self._codes()
                 )
@@ -806,6 +1034,13 @@ class LocalElasticAgent:
                             str(time.time()),
                         )
                     except Exception:
+                        new = self._try_store_failover()
+                        if new is not None:
+                            ctrl = new
+                            target = max(
+                                self._peeked_gen(ctrl), self.restart_count + 1
+                            )
+                            break
                         return RunResult(
                             WorkerState.FAILED,
                             self.restart_count,
@@ -838,9 +1073,15 @@ class LocalElasticAgent:
                         self._codes(),
                     )
                 if done == "fatal":
-                    return RunResult(
-                        WorkerState.FAILED, self.restart_count, self._codes()
-                    )
+                    new = self._try_store_failover()
+                    if new is None:
+                        return RunResult(
+                            WorkerState.FAILED,
+                            self.restart_count,
+                            self._codes(),
+                        )
+                    ctrl = new  # store died at success time: re-form on
+                    # the standby and let the re-run finish from ckpt
                 # "restart": rejoin the gang for the next generation
             # bracket the (potentially slow) teardown with heartbeats so
             # a SIGTERM-ignoring worker's kill wait cannot make THIS node
@@ -958,11 +1199,24 @@ class LocalElasticAgent:
         finally:
             self._stop_workers()
             if self._ctrl is not None and self._ctrl is not self._store:
-                try:
-                    self._ctrl.close()
-                except Exception:
-                    pass
+                if self._ctrl is not self._standby:
+                    try:
+                        self._ctrl.close()
+                    except Exception:
+                        pass
                 self._ctrl = None
             if self._store is not None:
                 self._store.close()
                 self._store = None
+            if self._standby is not None:
+                try:
+                    self._standby.close()
+                except Exception:
+                    pass
+                self._standby = None
+            if self._standby_jax_reserve is not None:
+                try:
+                    self._standby_jax_reserve.close()
+                except OSError:
+                    pass
+                self._standby_jax_reserve = None

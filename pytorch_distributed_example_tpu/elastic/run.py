@@ -58,6 +58,14 @@ def parse_args(argv=None):
     p.add_argument("--standalone", action="store_true",
                    help="single-node ephemeral rendezvous (torchrun "
                         "--standalone): ignore any rdzv endpoint")
+    p.add_argument("--no-store-failover", action="store_true",
+                   help="node-elastic: disable the standby rendezvous "
+                        "store (by default survivors promote a standby "
+                        "and re-form when the store HOST dies)")
+    p.add_argument("--advertise-addr", type=str, default=None,
+                   help="this node's dialable address for the standby "
+                        "store (defaults to a hostname lookup; loopback "
+                        "when the rdzv endpoint is loopback)")
     p.add_argument("--log-dir", type=str, default=None)
     p.add_argument("--no-python", action="store_true",
                    help="entrypoint is a raw command, not a python script")
@@ -136,6 +144,8 @@ def main(argv=None) -> int:
             master_port=master_port,
             raw_cmd=args.no_python,
             module=args.module,
+            store_failover=not args.no_store_failover,
+            advertise_addr=args.advertise_addr,
         )
     except ValueError as e:  # e.g. proc range with --nnodes > 1
         print(f"tpurun: {e}", file=sys.stderr)

@@ -43,7 +43,15 @@ def _interpret_default() -> bool:
 
     Checked via device platform, not just backend name, so TPU plugins
     registered under other platform names still get the compiled path.
+    TDX_FLASH_INTERPRET=0/1 overrides both — needed when AOT-compiling
+    for a DEVICELESS TPU topology from a CPU-pinned process, where the
+    attached-device heuristic would wrongly pick interpret mode.
     """
+    import os
+
+    env = os.environ.get("TDX_FLASH_INTERPRET")
+    if env is not None:
+        return env != "0"
     if jax.default_backend() == "tpu":
         return False
     try:

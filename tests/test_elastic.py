@@ -290,10 +290,10 @@ class TestNodeElastic:
 
     def _spec(self, tmp_path, port, node_rank, **kw):
         script = _write(tmp_path, f"worker{node_rank}.py", self.WORKER)
+        kw.setdefault("nnodes", 2)
         return WorkerSpec(
             entrypoint=[script],
             nproc_per_node=1,
-            nnodes=2,
             min_nnodes=1,
             node_rank=node_rank,
             master_port=port,
@@ -369,6 +369,66 @@ class TestNodeElastic:
         assert results[2].state is WorkerState.SUCCEEDED, results
         # membership changes were free; no local worker ever failed
         assert agents[0]._failure_restarts == 0
+
+    def test_store_host_loss_fails_over_to_standby(self, tmp_path):
+        """Beyond-torch: losing the rendezvous-store HOST (node 0) is
+        survivable. Every agent runs a cold-standby store and gossips
+        its endpoint in heartbeats; survivors converge on the first
+        live standby in node-id order and re-form the gang there."""
+        import threading
+
+        from tests._mp_util import free_port
+
+        port = free_port()
+        agents = {
+            n: LocalElasticAgent(self._spec(tmp_path, port, n, nnodes=3))
+            for n in (0, 1, 2)
+        }
+        results = {}
+        threads = {
+            n: threading.Thread(
+                target=lambda n=n: results.update({n: agents[n].run()})
+            )
+            for n in agents
+        }
+        for t in threads.values():
+            t.start()
+        try:
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"run_g0_w3_r{r}").exists() for r in range(3)
+                ),
+                what="gen0 three-node gang",
+            )
+            # node 0 — THE STORE HOST — dies abruptly; its run() teardown
+            # closes the daemon like a host loss would
+            agents[0].abort()
+            threads[0].join(timeout=60)
+            assert not threads[0].is_alive(), "node 0 did not die"
+            # survivors must re-form on a promoted standby: world 2,
+            # fresh group ranks
+            self._wait_for(
+                lambda: any(
+                    (tmp_path / f"run_g{g}_w2_r0").exists()
+                    and (tmp_path / f"run_g{g}_w2_r1").exists()
+                    for g in range(1, 8)
+                ),
+                timeout=120.0,
+                what="re-form on the standby store",
+            )
+            assert sorted(agents[1].members) == [1, 2]
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            for t in threads.values():
+                t.join(timeout=90)
+        assert results[1].state is WorkerState.SUCCEEDED, results
+        assert results[2].state is WorkerState.SUCCEEDED, results
+        # both survivors actually moved off the dead endpoint
+        for n in (1, 2):
+            assert agents[n].failovers >= 1, f"node {n} never failed over"
+            assert agents[n]._active_master != ("127.0.0.1", port)
+        # failover was a membership event, not a worker failure
+        assert agents[1]._failure_restarts == 0
 
     def test_spec_validation(self):
         with pytest.raises(ValueError, match="explicit master"):

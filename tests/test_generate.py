@@ -65,6 +65,7 @@ class TestDecodeParity:
                 rtol=2e-4, atol=2e-5,
             )
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_gqa_decode_matches_full_forward(self):
         import jax
         import jax.numpy as jnp

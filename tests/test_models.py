@@ -38,6 +38,7 @@ class TestResNet:
         out = model.apply(vars_, jnp.zeros((4, 32, 32, 3)))
         assert out.shape == (4, 10)
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_batchnorm_mutable_training(self):
         import jax
         import jax.numpy as jnp
@@ -94,6 +95,7 @@ class TestTransformerLM:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.9
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_gqa_matches_shapes(self):
         import jax
         import jax.numpy as jnp
@@ -146,6 +148,7 @@ class TestSyncBatchNorm:
     batch on one device — torch SyncBatchNorm's defining property
     (plain per-replica BN diverges here)."""
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_sharded_stats_match_full_batch(self):
         import jax
         import jax.numpy as jnp

@@ -241,6 +241,7 @@ class TestGatherScatter:
         g = jax.grad(lambda x: (f(x) ** 2).sum())(x)
         np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x), rtol=1e-5)
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_scatter_value_and_grad(self, mesh):
         import jax
         import jax.numpy as jnp

