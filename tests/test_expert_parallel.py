@@ -29,6 +29,7 @@ class TestMoELocal:
         assert y.shape == x.shape
         assert float(aux) > 0
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_every_kept_token_processed_by_argmax_expert(self):
         """With capacity >= T every token goes through its top expert."""
         import jax

@@ -109,6 +109,7 @@ class TestTransformerLM:
         assert k_kernel.shape == (64, 2 * 16)  # kv_heads * head_dim
         assert model.apply(params, toks).shape == (1, 16, 96)
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_causal_masking(self):
         """Perturbing future tokens must not change past logits."""
         import jax
@@ -202,6 +203,7 @@ class TestSyncBatchNorm:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
             )
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_plain_bn_diverges_without_sync(self):
         """Control: WITHOUT conversion the per-shard stats differ from
         the full batch — proving the sync actually does something."""
@@ -254,6 +256,7 @@ class TestBert:
         h, pooled = m.apply(p, ids)
         assert h.shape == (3, 16, 32) and pooled.shape == (3, 32)
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_attention_is_bidirectional(self):
         """Perturbing a LATE token must change EARLY positions' hidden
         states — the defining non-causal property."""
@@ -328,6 +331,7 @@ class TestBert:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # heavy compile: full-suite only (<2 min habit run)
     def test_tp_sharding_layout(self):
         import jax
         import jax.numpy as jnp
