@@ -28,9 +28,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # (it force-registers the TPU plugin), so the pin must run as code
 # before the first backend touch — same recipe as conftest.py.
 _CPU_PIN = (
-    "import sys, runpy, jax;"
+    "import os, sys, runpy, jax;"
     "jax.config.update('jax_platforms','cpu');"
-    "jax.config.update('jax_num_cpu_devices',8);"
+    "jax.config.update('jax_num_cpu_devices',"
+    " int(os.environ.get('TDX_CPU_DEVICES','8')));"
     "sys.argv = sys.argv[1:];"
     "runpy.run_path(sys.argv[0], run_name='__main__')"
 )
@@ -52,7 +53,13 @@ def _jobs(quick: bool):
         else {}
     )
     return [
-        ("headline", [sys.executable, "bench.py"], headline_env),
+        # headline under --cpu runs a 2-device mesh: it matches the
+        # 2-rank gloo reference geometry AND dodges XLA CPU's hardcoded
+        # 40 s collective-rendezvous abort — on a small loaded host, 8
+        # per-device threads can miss that window and the runtime
+        # SIGABRTs the process (xla rendezvous.cc:127).
+        ("headline", [sys.executable, "bench.py"],
+         dict(headline_env, TDX_CPU_DEVICES="2")),
         (
             "allreduce_bw",
             [sys.executable, "benchmarks/allreduce_bw.py"]
@@ -108,7 +115,9 @@ def _jobs(quick: bool):
             "llama_scaled_memory8b",
             [sys.executable, "benchmarks/llama_scaled.py", "--mode", "memory8b"]
             + (["--seq", "512", "--batch", "2"] if q else []),
-            {},
+            # the 8-device layout IS the measurement: an ambient
+            # TDX_CPU_DEVICES (the headline knob) must not change it
+            {"TDX_CPU_DEVICES": "8"},
         ),
         (
             "trace_evidence",
@@ -146,6 +155,13 @@ def _jobs(quick: bool):
             [sys.executable, "benchmarks/llama_scaled.py", "--mode",
              "memory8b", "--target", "tpu"]
             + (["--seq", "512", "--batch", "2"] if q else []),
+            {"TDX_CPU_DEVICES": "8"},  # see llama_scaled_memory8b
+        ),
+        (
+            # flash compile matrix + roofline MFU ceilings, also
+            # deviceless (round-3 VERDICT #2's ceiling analysis)
+            "tpu_aot_check",
+            [sys.executable, "benchmarks/tpu_aot_check.py"],
             {},
         ),
     ]
@@ -217,10 +233,25 @@ def main():
             argv = [sys.executable, "-c", _CPU_PIN] + argv[1:]
         t0 = time.time()
         try:
-            r = subprocess.run(
-                argv, cwd=ROOT, env=env, capture_output=True, text=True,
-                timeout=args.timeout,
-            )
+            # one retry on signal-crash: XLA CPU's HARDCODED 40 s
+            # collective-rendezvous abort (rendezvous.cc:127) fires when
+            # a loaded small host starves a device thread past the
+            # window — transient load, not the bench, is the usual
+            # culprit. t0 resets so 'seconds' reflects the attempt that
+            # produced the recorded result.
+            attempts = 0
+            for attempt in range(2):
+                attempts += 1
+                t0 = time.time()
+                r = subprocess.run(
+                    argv, cwd=ROOT, env=env, capture_output=True, text=True,
+                    timeout=args.timeout,
+                )
+                if r.returncode >= 0:
+                    break
+                print(f"[{name}] crashed (rc={r.returncode})"
+                      + ("; retrying once" if attempt == 0 else ""),
+                      flush=True)
             rec = _last_json_line(r.stdout)
             # never let a CPU-fallback rerun clobber persisted TPU
             # evidence for the same job (the whole point of merging)
@@ -244,6 +275,8 @@ def main():
                 "seconds": round(time.time() - t0, 1),
                 "result": rec,
             }
+            if attempts > 1:
+                results[name]["attempts"] = attempts
             if r.returncode != 0 or rec is None:
                 results[name]["stderr_tail"] = r.stderr[-500:]
         except subprocess.TimeoutExpired:

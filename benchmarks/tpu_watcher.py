@@ -55,6 +55,17 @@ BATTERY = [
         ["benchmarks/results.json"],
     ),
     (
+        # the AOT roofline says no-remat is compute-bound with headroom
+        # (ceiling 1.15 vs 0.93) and fits 15.3 GB < 16 GB — likely the
+        # best single-chip MFU configuration
+        "llama_mfu_1b_noremat",
+        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
+         "--no-remat"],
+        {"TDX_MFU_KEY_SUFFIX": "_noremat"},
+        2400,
+        ["benchmarks/results.json"],
+    ),
+    (
         "flash_sweep_L512_dh64",
         [
             sys.executable, "benchmarks/flash_bench.py",

@@ -86,7 +86,10 @@ class WorkerSpec:
     # it — only rendezvous capability moves. Note the alignment: the
     # adopted standby's owner is the lowest surviving node, which is
     # also group_rank 0, so the jax-coordinator (+1 port) convention
-    # keeps pointing at the host that binds it.
+    # keeps pointing at the host that binds it. Limitation: an agent
+    # STARTED after a failover has no gossip cache and must be pointed
+    # at the adopted endpoint explicitly (it is printed on stderr at
+    # promotion time); survivors need nothing.
     store_failover: bool = True  # node-elastic only
     advertise_addr: Optional[str] = None  # this agent's dialable host
     failover_grace_s: Optional[float] = None  # default 2x heartbeat timeout

@@ -430,6 +430,20 @@ P2P_WORKER = textwrap.dedent(
         got = np.zeros((2,), object)
         tdx.recv(got, src=0, tag=5)
         assert got.tolist() == ["a", "bc"], got
+    # ring exchange via batch_isend_irecv (the pipeline-parallel stage
+    # pattern; torch distributed_c10d.py:2990), cross-process over the
+    # active route
+    nxt, prv = (rank + 1) % world, (rank - 1) % world
+    sendbuf = np.full((8,), float(rank), np.float32)
+    recvbuf = np.zeros((8,), np.float32)
+    ops = [
+        tdx.P2POp(tdx.isend, sendbuf, peer=nxt, tag=21),
+        tdx.P2POp(tdx.irecv, recvbuf, peer=prv, tag=21),
+    ]
+    for w in tdx.batch_isend_irecv(ops):
+        w.wait()
+    assert recvbuf.tolist() == [float(prv)] * 8, recvbuf
+
     if plane_on:
         # the whole point: plane traffic leaves NO p2p payload in the store
         scope = dist._world.scope
