@@ -260,21 +260,94 @@ def _flash_matrix(dev):
     return rec
 
 
+def _ring_longctx(topo, L_global=65536, B=1, H=8, D=128):
+    """Long-context proof: ring attention over the FULL topology at a
+    sequence no single chip could hold, compiled by the TPU backend
+    with its per-device memory accounting. 64k causal attention dense
+    would need an L x L score matrix; the ring schedule keeps one
+    (L/W) x (L/W) block live per step and streams KV around the ICI
+    ring (parallel/context_parallel.py ring_attention)."""
+    import numpy as np_
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from benchmarks.common import emit, persist_result
+    from pytorch_distributed_example_tpu._compat import shard_map_fn
+    from pytorch_distributed_example_tpu.parallel.context_parallel import (
+        ring_attention,
+    )
+
+    devs = list(topo.devices)
+    mesh = Mesh(np_.array(devs), ("sp",))
+    spec = P(None, "sp", None, None)
+    fn = shard_map_fn(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=spec,
+    )
+    qs = jax.ShapeDtypeStruct(
+        (B, L_global, H, D), jnp.bfloat16,
+        sharding=NamedSharding(mesh, spec),
+    )
+    try:
+        t0 = time.time()
+        compiled = jax.jit(fn).lower(qs, qs, qs).compile()
+        compile_s = time.time() - t0
+    except Exception as e:
+        emit("aot_ring_attention_64k", 0.0, "GB/device",
+             error=f"{type(e).__name__}: {str(e)[:300]}")
+        return
+    mem = _mem(compiled)
+    flops, bytes_acc = _cost(compiled)
+    total = mem["argument_size_in_bytes"] + mem["temp_size_in_bytes"]
+    rec = emit(
+        "aot_ring_attention_64k",
+        round(total / 1e9, 3),
+        "GB/device",
+        evidence="aot_compile_only",
+        seq_global=L_global,
+        seq_per_device=L_global // len(devs),
+        n_devices=len(devs),
+        heads=H,
+        head_dim=D,
+        hw_flops=flops,
+        memory=mem,
+        compile_s=round(compile_s, 1),
+        fits_16gb_hbm=bool(total < 16e9),
+        device_kind=devs[0].device_kind,
+    )
+    persist_result("aot_ring_attention_64k", rec)
+
+
 def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     os.environ["TDX_FLASH_INTERPRET"] = "0"  # Mosaic path for the TPU target
 
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(
+        platform="tpu",
+        topology_name=os.environ.get("TDX_AOT_TOPO_FULL", "v5e:2x4"),
+    )
     dev = _single_device()
     from benchmarks.llama_scaled import CFG_1B
 
     _flash_matrix(dev)
     # headline MFU geometry (bench.py): 512d/8L/8h @ L=512 B=8
-    headline = dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=8)
-    _ceiling_row("aot_ceiling_headline_mfu", dev, headline, 512, 8, persist=True)
+    _ceiling_row("aot_ceiling_headline_mfu", dev, headline_cfg(), 512, 8,
+                 persist=True)
     # ~1B single-chip config (llama_scaled --mode mfu): L=1024 B=8
     _ceiling_row("aot_ceiling_llama1b_mfu", dev, CFG_1B, 1024, 8, persist=True)
+    # long-context: 64k causal ring attention over the 8-chip topology
+    _ring_longctx(topo)
+
+
+def headline_cfg():
+    return dict(vocab_size=32000, d_model=512, n_layers=8, n_heads=8)
 
 
 if __name__ == "__main__":
