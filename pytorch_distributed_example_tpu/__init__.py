@@ -69,6 +69,38 @@ from .distributed import (  # noqa: F401
     get_group_rank,
     get_global_rank,
     coalescing_manager,
+    send_object_list,
+    recv_object_list,
+    all_reduce_coalesced,
+    all_gather_coalesced,
+    new_subgroups_by_enumeration,
+    is_available,
+    is_backend_available,
+    is_nccl_available,
+    is_gloo_available,
+    is_mpi_available,
+    is_ucc_available,
+    is_torchelastic_launched,
+    get_node_local_rank,
+    get_pg_count,
+    DebugLevel,
+    get_debug_level,
+    set_debug_level,
+    set_debug_level_from_env,
+    reduce_op,
+)
+from .types import (  # noqa: F401
+    DistBackendError,
+    DistError,
+    DistNetworkError,
+    DistStoreError,
+)
+from .store import (  # noqa: F401  (torch exposes the store family here)
+    FileStore,
+    HashStore,
+    PrefixStore,
+    Store,
+    TCPStore,
 )
 from .data.sampler import DistributedSampler  # noqa: F401
 from .parallel.ddp import DistributedDataParallel, make_ddp_train_step  # noqa: F401

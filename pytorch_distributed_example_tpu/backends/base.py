@@ -18,10 +18,10 @@ from __future__ import annotations
 from typing import Any, List, Optional, Sequence, Tuple
 
 from ..mesh import DeviceMesh
-from ..types import ReduceOp, Work
+from ..types import DistBackendError, ReduceOp, Work
 
 
-class BackendError(RuntimeError):
+class BackendError(DistBackendError):
     pass
 
 

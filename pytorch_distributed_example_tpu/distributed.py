@@ -24,6 +24,7 @@ API —
 from __future__ import annotations
 
 import datetime
+import enum
 import logging
 import os
 import pickle
@@ -470,6 +471,28 @@ def _new_group_internal(
         mesh = world.mesh.submesh([world.ranks.index(r) if r in world.ranks else r for r in ranks])
     flat = mesh.flattened("_ranks")
     backend = _backends.create_backend(backend_name, flat, 0, len(ranks), tsec)
+    if get_debug_level() == DebugLevel.DETAIL:
+        # torch: TORCH_DISTRIBUTED_DEBUG=DETAIL wraps every group in
+        # ProcessGroupWrapper (distributed_c10d.py:5440) — collective
+        # fingerprints are compared across ranks before dispatch
+        from .backends.wrapper import ProcessGroupWrapper
+
+        if _world.mode == "multiproc":
+            # the wrapper's fingerprint barrier is keyed by GROUP rank
+            # (pgw/<seq>/<rank> for rank in range(group size)); a
+            # non-member process still constructs the group object
+            # collectively but never dispatches on it
+            my = ranks.index(_world.process_rank) \
+                if _world.process_rank in ranks else -1
+        else:
+            my = 0
+        backend = ProcessGroupWrapper(
+            backend,
+            store,
+            my,
+            len(ranks),
+            driver_mode=_world.mode != "multiproc",
+        )
     pg = ProcessGroup(flat, ranks, backend_name, backend, store, name, tsec)
     _world.pg_map[name] = pg
     _world.pg_names[id(pg)] = name
@@ -1436,6 +1459,14 @@ class _StoreRecvWork(Work):
         return self.value
 
 
+def _check_user_tag(tag: int) -> None:
+    # torch/NCCL contract: user tags are non-negative; negatives are this
+    # runtime's reserved internal channels (e.g. object-list p2p)
+    if tag < 0:
+        raise ValueError(f"p2p tag must be >= 0 (got {tag}); negative "
+                         "tags are reserved for internal channels")
+
+
 def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = None):
     """torch `send` (`distributed_c10d.py:2598`).
 
@@ -1443,6 +1474,7 @@ def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = Non
     (blocking-receive contract, like gloo's TCP p2p). Driver mode: all
     ranks live here, so a send is half of a ppermute pair and needs the
     acting rank via `src=`."""
+    _check_user_tag(tag)
     g = _resolve(group)
     if _world.mode == "multiproc":
         _store_send(tensor, dst, g, tag)
@@ -1465,6 +1497,7 @@ def recv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Op
     value is also returned via `recv.last_value`. Driver mode: the
     matching send already routed data into the rank-stacked array
     (send+recv are one ppermute), so this is a no-op returning src."""
+    _check_user_tag(tag)
     g = _resolve(group)
     if _world.mode == "multiproc":
         if src is None:
@@ -1476,6 +1509,7 @@ def recv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Op
 
 
 def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = None) -> Work:
+    _check_user_tag(tag)
     g = _resolve(group)
     if _world.mode == "multiproc":
         _store_send(tensor, dst, g, tag)  # store set is synchronous
@@ -1491,6 +1525,7 @@ def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = No
 
 
 def irecv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: Optional[int] = None) -> Work:
+    _check_user_tag(tag)
     g = _resolve(group)
     if _world.mode == "multiproc":
         return _StoreRecvWork(tensor, src, g, tag)
@@ -1643,3 +1678,222 @@ def scatter_object_list(
     for i in range(W):
         ln = int(np.frombuffer(out[i, :8].tobytes(), dtype=np.int64)[0])
         scatter_object_output_list.append(_array_to_obj(out[i, 8:], ln))
+
+
+# ---------------------------------------------------------------------------
+# object p2p — torch `distributed_c10d.py:3250,3339`
+# ---------------------------------------------------------------------------
+
+
+def send_object_list(object_list: List[Any], dst: int, group=None, device=None):
+    """torch `send_object_list` (`:3250`): pickle each object and send
+    (count/lengths header, then payload) to dst. Multiproc mode rides
+    the p2p data plane like tensor send. Driver mode raises — all ranks
+    live in one process there; use the object collectives
+    (`broadcast_object_list` / `gather_object`) instead."""
+    g = _resolve(group)
+    if _world.mode != "multiproc":
+        raise RuntimeError(
+            "send_object_list is per-process (multiproc mode); driver "
+            "mode holds every rank — use broadcast_object_list/"
+            "gather_object"
+        )
+    bufs = [_obj_to_array(o) for o in object_list]
+    header = np.array([len(bufs)] + [len(b) for b in bufs], np.int64)
+    _store_send(header, dst, g, tag=_OBJ_P2P_TAG)
+    payload = (
+        np.concatenate(bufs) if bufs else np.zeros((0,), np.uint8)
+    )
+    _store_send(payload, dst, g, tag=_OBJ_P2P_TAG)
+
+
+def recv_object_list(
+    object_list: List[Any], src: Optional[int] = None, group=None, device=None
+) -> int:
+    """torch `recv_object_list` (`:3339`): receive into object_list IN
+    PLACE (its length bounds how many objects are taken); returns the
+    source rank. src=None accepts from any sender."""
+    g = _resolve(group)
+    if _world.mode != "multiproc":
+        raise RuntimeError(
+            "recv_object_list is per-process (multiproc mode); driver "
+            "mode holds every rank — use broadcast_object_list/"
+            "gather_object"
+        )
+    if src is None:
+        src, header = _store_recv_any(None, g, _OBJ_P2P_TAG, g.timeout)
+    else:
+        header = _store_recv(None, src, g, _OBJ_P2P_TAG, g.timeout)
+    payload = _store_recv(None, src, g, _OBJ_P2P_TAG, g.timeout)
+    n = int(header[0])
+    lens = [int(x) for x in header[1 : 1 + n]]
+    objs = []
+    off = 0
+    for ln in lens:
+        objs.append(_array_to_obj(np.asarray(payload[off : off + ln]), ln))
+        off += ln
+    for i in range(min(len(object_list), len(objs))):
+        object_list[i] = objs[i]
+    return src
+
+
+# Internal object-list channel. Public p2p enforces tag >= 0 (the torch/
+# NCCL contract), so negative tags are a reserved internal namespace and
+# cannot collide with user traffic.
+_OBJ_P2P_TAG = -7
+
+
+# ---------------------------------------------------------------------------
+# coalesced convenience collectives — torch `all_reduce_coalesced` /
+# `all_gather_coalesced` (`distributed_c10d.py`; legacy API kept for ported
+# scripts — the coalescing_manager is the modern spelling)
+# ---------------------------------------------------------------------------
+
+
+def all_reduce_coalesced(tensors, op: ReduceOp = ReduceOp.SUM, group=None,
+                         async_op: bool = False):
+    """One wait covers every tensor (torch semantic); dispatches ride the
+    coalescing manager so the XLA programs queue back-to-back."""
+    g = _resolve(group)
+    with coalescing_manager(g, async_ops=True) as cm:
+        for t in tensors:
+            all_reduce(t, op, g, async_op=True)
+    if async_op:
+        return cm
+    cm.wait()
+    return None
+
+
+def all_gather_coalesced(output_tensor_lists, input_tensor_list, group=None,
+                         async_op: bool = False):
+    """Legacy torch API: gather each input; output_tensor_lists[i] is
+    filled with the W per-rank pieces of input i."""
+    g = _resolve(group)
+    works = []
+    for i, t in enumerate(input_tensor_list):
+        res = all_gather(t, g)
+        gathered = res.local_numpy()[0] if _world.mode == "multiproc" \
+            else res.numpy()[0]
+        out = output_tensor_lists[i]
+        for r in range(g.size()):
+            out[r][...] = np.asarray(gathered[r])
+    if async_op:
+        return CompletedWork(None, OpType.ALLGATHER)
+    return None
+
+
+def new_subgroups_by_enumeration(
+    ranks_per_subgroup_list, timeout=None, backend: Optional[str] = None
+):
+    """torch `new_subgroups_by_enumeration` (`distributed_c10d.py:6210`):
+    explicit rank lists -> (this rank's subgroup, all subgroups)."""
+    seen: set = set()
+    for rs in ranks_per_subgroup_list:
+        for r in rs:
+            if r in seen:
+                raise ValueError(f"rank {r} appears in more than one subgroup")
+            seen.add(r)
+    me = _world.process_rank
+    cur = None
+    groups = []
+    for rs in ranks_per_subgroup_list:
+        gp = new_group(rs, timeout=timeout, backend=backend)
+        groups.append(gp)
+        if me in rs:
+            cur = gp
+    if cur is None and _world.mode != "multiproc":
+        # driver process acts for every rank; mirror new_subgroups'
+        # convention of "its" subgroup being the first
+        cur = groups[0]
+    # multiproc rank covered by no subgroup: cur stays None (torch
+    # returns None so ported code can gate collectives on membership)
+    return cur, groups
+
+
+# ---------------------------------------------------------------------------
+# environment probes + debug level — torch `torch.distributed` module surface
+# ---------------------------------------------------------------------------
+
+
+def is_available() -> bool:
+    """torch `is_available` — this build always ships the c10d surface."""
+    return True
+
+
+def is_backend_available(backend: str) -> bool:
+    from .backends import backend_registered
+
+    return backend_registered(backend or "")
+
+
+def is_nccl_available() -> bool:
+    return False  # CUDA stack; --backend nccl aliases to the XLA backend
+
+
+def is_gloo_available() -> bool:
+    return False  # --backend gloo aliases to the XLA backend
+
+
+def is_mpi_available() -> bool:
+    return False
+
+
+def is_ucc_available() -> bool:
+    return False
+
+
+def is_torchelastic_launched() -> bool:
+    """torch checks TORCHELASTIC_RUN_ID (`distributed_c10d.py`); our agent
+    exports it (plus the TDX_* contract) for exactly this probe."""
+    return bool(
+        os.environ.get("TORCHELASTIC_RUN_ID")
+        or os.environ.get("TDX_AGENT_STORE")
+    )
+
+
+def get_node_local_rank(fallback_rank: Optional[int] = None) -> int:
+    """torch `get_node_local_rank`: LOCAL_RANK env, else the fallback."""
+    v = os.environ.get("LOCAL_RANK")
+    if v is not None:
+        return int(v)
+    if fallback_rank is not None:
+        return int(fallback_rank)
+    raise RuntimeError(
+        "LOCAL_RANK is not set and no fallback_rank was provided"
+    )
+
+
+def get_pg_count() -> int:
+    return len(_world.pg_map)
+
+
+class DebugLevel(enum.IntEnum):
+    """torch `DebugLevel` (`distributed_c10d.py` / TORCH_DISTRIBUTED_DEBUG)."""
+
+    OFF = 0
+    INFO = 1
+    DETAIL = 2
+
+
+_debug_level: Optional[DebugLevel] = None
+
+
+def set_debug_level(level: DebugLevel) -> None:
+    global _debug_level
+    _debug_level = DebugLevel(level)
+
+
+def set_debug_level_from_env() -> None:
+    global _debug_level
+    name = os.environ.get("TORCH_DISTRIBUTED_DEBUG", "OFF").upper()
+    _debug_level = DebugLevel[name] if name in DebugLevel.__members__ else DebugLevel.OFF
+
+
+def get_debug_level() -> DebugLevel:
+    if _debug_level is None:
+        set_debug_level_from_env()
+    return _debug_level
+
+
+# deprecated alias torch still exposes
+reduce_op = ReduceOp

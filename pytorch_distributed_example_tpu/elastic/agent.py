@@ -353,6 +353,10 @@ class LocalElasticAgent:
                 "MASTER_PORT": str(port),
                 "TDX_RESTART_COUNT": str(self.restart_count),
                 "TORCHELASTIC_RESTART_COUNT": str(self.restart_count),
+                # the probe torch's is_torchelastic_launched() reads
+                "TORCHELASTIC_RUN_ID": os.environ.get(
+                    "TORCHELASTIC_RUN_ID", f"tdx-{os.getpid()}"
+                ),
                 "TDX_AGENT_STORE": f"{master_addr}:{port}",
                 # env:// rendezvous must CONNECT to the agent's store, not
                 # bind MASTER_PORT itself (torchelastic's

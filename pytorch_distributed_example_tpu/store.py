@@ -23,11 +23,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .types import DistStoreError
+
 DEFAULT_PORT = 29500  # torch TCPStore.hpp:87
 _DEFAULT_TIMEOUT = 300.0
 
 
-class StoreTimeoutError(TimeoutError):
+class StoreTimeoutError(DistStoreError, TimeoutError):
     pass
 
 

@@ -20,6 +20,25 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 
+class DistError(RuntimeError):
+    """Base of the distributed error hierarchy — torch `DistError`
+    (torch/csrc/distributed/c10d/exception.h): ported except-clauses
+    catch the same taxonomy here."""
+
+
+class DistBackendError(DistError):
+    """torch `DistBackendError` — backend resolution/dispatch failures."""
+
+
+class DistStoreError(DistError):
+    """torch `DistStoreError` — KV-store failures (timeouts subclass
+    TimeoutError too, preserving existing except TimeoutError sites)."""
+
+
+class DistNetworkError(DistError):
+    """torch `DistNetworkError` — connection-level failures."""
+
+
 class ReduceOp(enum.Enum):
     """Reduction algebra for all_reduce / reduce / reduce_scatter.
 

@@ -430,6 +430,15 @@ P2P_WORKER = textwrap.dedent(
         got = np.zeros((2,), object)
         tdx.recv(got, src=0, tag=5)
         assert got.tolist() == ["a", "bc"], got
+    # object-list p2p (torch send_object_list/recv_object_list,
+    # distributed_c10d.py:3250,3339), cross-process over the active route
+    if rank == 0:
+        tdx.send_object_list([{"cfg": [1, 2]}, "meta", 7], dst=1)
+    else:
+        got = [None, None, None]
+        src = tdx.recv_object_list(got, src=None)
+        assert src == 0 and got == [{"cfg": [1, 2]}, "meta", 7], got
+
     # ring exchange via batch_isend_irecv (the pipeline-parallel stage
     # pattern; torch distributed_c10d.py:2990), cross-process over the
     # active route
