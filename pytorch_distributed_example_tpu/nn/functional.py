@@ -137,3 +137,30 @@ def scatter(x, src: int = 0, axis_name: str = "dp", axis: int = 0):
     i = lax.axis_index(axis_name)
     n = full.shape[axis] // W
     return lax.dynamic_slice_in_dim(full, i * n, n, axis=axis)
+
+
+def reduce(x, dst: int = 0, op=ReduceOp.SUM, axis_name: str = "dp"):
+    """Differentiable reduce-to-dst (torch `nn.functional.reduce`,
+    `_Reduce`): rank `dst` receives the reduction; other ranks get zeros
+    (SPMD needs a shape-uniform value everywhere — torch returns the
+    input unchanged off-dst, which no ported loss should consume).
+    Backward is the transpose of psum×mask: the cotangent at `dst`
+    broadcasts to every contributing rank — torch `_Reduce.backward`'s
+    broadcast-from-dst semantics."""
+    from jax import lax
+
+    reduced = all_reduce(x, op, axis_name)
+    mask = (lax.axis_index(axis_name) == dst).astype(x.dtype)
+    return reduced * mask
+
+
+def all_to_all_single(x, axis_name: str = "dp", split_axis: int = 0,
+                      concat_axis: int = 0):
+    """torch `nn.functional.all_to_all_single` on the single-tensor
+    layout: dim `split_axis` is split W ways, chunk i goes to rank i,
+    received chunks concatenate along `concat_axis`. Even splits only
+    (static shapes under jit); uneven sizes pad upstream — the eager
+    `distributed.all_to_all_single` supports true uneven splits.
+    Backward is the inverse all_to_all (self-transposing collective)."""
+    return all_to_all(x, axis_name, split_axis=split_axis,
+                      concat_axis=concat_axis)

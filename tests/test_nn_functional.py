@@ -263,3 +263,73 @@ class TestGatherScatter:
         g = np.asarray(jax.grad(loss)(x)).reshape(W, n, d)
         g_want = np.asarray(jax.grad(dense_loss)(x)).reshape(W, n, d)
         np.testing.assert_allclose(g, g_want, rtol=1e-4)
+
+
+class TestReduce:
+    def test_value_and_grad_broadcast_from_dst(self, mesh):
+        """torch `_Reduce`: dst holds the SUM, others zeros here (SPMD
+        shape uniformity); grad of a dst-consuming loss broadcasts the
+        cotangent to every contributing rank."""
+        import jax
+        import jax.numpy as jnp
+
+        x = _x(11)
+        n = x.shape[0] // W
+        dst = 2
+
+        f = _shard_mapped(lambda x: F.reduce(x, dst, ReduceOp.SUM, "dp"), mesh)
+        y = np.asarray(f(x)).reshape(W, n, x.shape[1])
+        want = np.asarray(x).reshape(W, n, x.shape[1]).sum(axis=0)
+        np.testing.assert_allclose(y[dst], want, rtol=1e-5)
+        for r in range(W):
+            if r != dst:
+                assert np.abs(y[r]).sum() == 0
+
+        def loss(x):
+            out = f(x).reshape(W, n, x.shape[1])
+            return (out[dst] ** 2).sum()
+
+        def dense_loss(x):
+            s = x.reshape(W, n, x.shape[1]).sum(axis=0)
+            return (s**2).sum()
+
+        np.testing.assert_allclose(float(loss(x)), float(dense_loss(x)), rtol=1e-5)
+        g = np.asarray(jax.grad(loss)(x))
+        g_want = np.asarray(jax.grad(dense_loss)(x))
+        np.testing.assert_allclose(g, g_want, rtol=1e-4)
+
+    def test_avg_lowering(self, mesh):
+        x = _x(12)
+        n = x.shape[0] // W
+        f = _shard_mapped(lambda x: F.reduce(x, 0, ReduceOp.AVG, "dp"), mesh)
+        y = np.asarray(f(x)).reshape(W, n, x.shape[1])
+        want = np.asarray(x).reshape(W, n, x.shape[1]).mean(axis=0)
+        np.testing.assert_allclose(y[0], want, rtol=1e-5)
+
+
+class TestAllToAllSingle:
+    def test_matches_all_to_all_and_inverts_in_grad(self, mesh):
+        """Single-tensor layout: chunk i of each rank lands on rank i;
+        the VJP is the inverse exchange (self-transposing collective)."""
+        import jax
+
+        x = _x(13, n=W)  # per-rank (W, d): one row per destination
+        f = _shard_mapped(
+            lambda x: F.all_to_all_single(x, "dp"), mesh
+        )
+        y = np.asarray(f(x)).reshape(W, W, x.shape[1])
+        xb = np.asarray(x).reshape(W, W, x.shape[1])
+        for dst in range(W):
+            for src in range(W):
+                np.testing.assert_allclose(y[dst, src], xb[src, dst], rtol=1e-6)
+        # grad: d/dx of sum(y * c) routes c back through the inverse
+        c = np.asarray(_x(14, n=W))
+
+        def loss(x):
+            return (f(x) * c).sum()
+
+        g = np.asarray(jax.grad(loss)(x)).reshape(W, W, x.shape[1])
+        cb = c.reshape(W, W, x.shape[1])
+        for src in range(W):
+            for dst in range(W):
+                np.testing.assert_allclose(g[src, dst], cb[dst, src], rtol=1e-6)
