@@ -16,6 +16,8 @@ dense references:
   reduce_scatter:   y_i = (Σ_j x_j)[i]     dx_j = concat_i ct_i (all_gather)
   broadcast(src):   y_i = x_src            dx_src = Σ_i ct_i, else 0
   all_to_all:       transpose of shards    inverse all_to_all
+  all_to_all_single: single-tensor chunk exchange (same transpose)
+  reduce(dst):      dst gets Σ_j x_j       dx_j = ct_dst (broadcast from dst)
   gather(dst):      dst gets concat_j x_j  dx_j = ct[j] (scatter from dst)
   scatter(src):     y_i = x_src[i]         dx_src = concat_i ct_i (gather)
 
@@ -162,5 +164,15 @@ def all_to_all_single(x, axis_name: str = "dp", split_axis: int = 0,
     (static shapes under jit); uneven sizes pad upstream — the eager
     `distributed.all_to_all_single` supports true uneven splits.
     Backward is the inverse all_to_all (self-transposing collective)."""
+    from jax import lax
+
+    W = lax.axis_size(axis_name)
+    if x.shape[split_axis] % W != 0:
+        raise ValueError(
+            f"all_to_all_single: dim {split_axis} of size "
+            f"{x.shape[split_axis]} not divisible by axis {axis_name!r} "
+            f"size {W}; pad upstream (uneven splits live in the eager "
+            "distributed.all_to_all_single)"
+        )
     return all_to_all(x, axis_name, split_axis=split_axis,
                       concat_axis=concat_axis)
