@@ -480,6 +480,7 @@ def make_ddp_train_step(
 
     step.mesh = mesh
     step.axis = axis
+    step._jitted = jitted  # AOT introspection: .lower() for HLO/cost dumps
     return step
 
 
