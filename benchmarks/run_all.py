@@ -61,6 +61,15 @@ def _jobs(quick: bool):
         ("headline", [sys.executable, "bench.py"],
          dict(headline_env, TDX_CPU_DEVICES="2")),
         (
+            # same-session interleaved A/B vs torch at the stock 2-rank
+            # geometry (round-4 verdict #2) — subprocess-per-rep, so the
+            # outer pin does not matter
+            "headline_breakdown",
+            [sys.executable, "benchmarks/headline_breakdown.py"]
+            + (["--reps", "1", "--steps", "30"] if q else []),
+            {},
+        ),
+        (
             "allreduce_bw",
             [sys.executable, "benchmarks/allreduce_bw.py"]
             + (["--max-mb", "1", "--iters", "3", "--warmup", "1"] if q else []),
@@ -231,6 +240,11 @@ def main():
         # topology (works under the cpu pin, avoiding a hung tunnel).
         if args.cpu or name.startswith("llama_scaled_memory8b"):
             argv = [sys.executable, "-c", _CPU_PIN] + argv[1:]
+        if args.cpu:
+            # bench.py's own TPU probe must be skipped too: the in-process
+            # pin does not reach its probe SUBPROCESS, which would poll a
+            # dead tunnel for the whole BENCH_WINDOW_S before falling back
+            env.setdefault("BENCH_PLATFORM", "cpu")
         t0 = time.time()
         try:
             # one retry on signal-crash: XLA CPU's HARDCODED 40 s

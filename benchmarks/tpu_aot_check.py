@@ -130,6 +130,25 @@ def _compile_train_step(dev, cfg_kw, L, B, use_flash, remat):
     return compiled, n_params, cfg, time.perf_counter() - t0
 
 
+def _flash_train_flops(cfg_kw, L, B, remat):
+    """Analytic FLOPs executed INSIDE the flash-attention Pallas kernels
+    per train step. XLA's cost_analysis() counts custom calls as ZERO
+    flops, which made round-4's no-remat "ceiling" land at an unphysical
+    1.149 (hw_vs_model_flops 0.871 — hardware doing fewer FLOPs than the
+    model needs is impossible; round-4 verdict #3). The kernel FLOPs are
+    exactly computable from the config:
+
+      fwd (causal):  2 matmuls (QK^T, PV) over the lower triangle
+                     = 0.5 * 2 * (2 * B * H * L^2 * Dh) = 2*B*L^2*d_model
+      bwd kernel:    5 matmuls (recompute P, dV, dP, dQ, dK) = 2.5x fwd
+      remat:         jax.checkpoint re-runs the fwd kernel inside bwd
+
+    per layer, times n_layers."""
+    fwd = 2.0 * B * L * L * cfg_kw["d_model"]  # causal-halved, all heads
+    mult = 1.0 + 2.5 + (1.0 if remat else 0.0)
+    return cfg_kw["n_layers"] * fwd * mult
+
+
 def _ceiling_row(name, dev, cfg_kw, L, B, persist):
     from benchmarks.common import emit, persist_result
     from benchmarks.llama_scaled import _analytic_flops
@@ -137,22 +156,27 @@ def _ceiling_row(name, dev, cfg_kw, L, B, persist):
     peak_flops, hbm_bw = _specs(dev.device_kind)
     rows = {}
     for remat in (True, False):
+        key = "remat" if remat else "no_remat"
         try:
             compiled, n_params, cfg, compile_s = _compile_train_step(
                 dev, cfg_kw, L, B, use_flash=True, remat=remat
             )
-            hw_flops, bytes_acc = _cost(compiled)
-            rows["remat" if remat else "no_remat"] = {
-                "hw_flops": hw_flops,
+            hw_flops_xla, bytes_acc = _cost(compiled)
+            flash_flops = _flash_train_flops(cfg_kw, L, B, remat)
+            rows[key] = {
+                # total = XLA-counted + the custom-call FLOPs XLA cannot
+                # see; the components are recorded so the correction is
+                # auditable
+                "hw_flops": hw_flops_xla + flash_flops,
+                "hw_flops_xla_counted": hw_flops_xla,
+                "flash_flops_analytic": flash_flops,
                 "bytes_accessed": bytes_acc,
                 "memory": _mem(compiled),
                 "compile_s": round(compile_s, 1),
                 "n_params": n_params,
             }
         except Exception as e:
-            rows["remat" if remat else "no_remat"] = {
-                "error": f"{type(e).__name__}: {str(e)[:300]}"
-            }
+            rows[key] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     ok = {k: v for k, v in rows.items() if "hw_flops" in v}
     if not ok:
         rec = emit(name, 0.0, "mfu_ceiling", error="no variant compiled",
@@ -166,8 +190,9 @@ def _ceiling_row(name, dev, cfg_kw, L, B, persist):
     for k, v in ok.items():
         time_lb = max(v["hw_flops"] / peak_flops,
                       v["bytes_accessed"] / hbm_bw)
-        ceilings[k] = {
-            "mfu_ceiling": round(model_flops / (time_lb * peak_flops), 4),
+        ceiling = model_flops / (time_lb * peak_flops)
+        row = {
+            "mfu_ceiling": round(min(ceiling, 1.0), 4),
             "bound": (
                 "compute" if v["hw_flops"] / peak_flops
                 >= v["bytes_accessed"] / hbm_bw else "memory"
@@ -177,6 +202,17 @@ def _ceiling_row(name, dev, cfg_kw, L, B, persist):
             ),
             "hw_vs_model_flops": round(v["hw_flops"] / model_flops, 3),
         }
+        if ceiling > 1.0:
+            row["clamped_from"] = round(ceiling, 4)
+        if v["hw_flops"] < model_flops:
+            # a real train step cannot execute fewer hardware FLOPs than
+            # the model requires: if this fires, some op's FLOPs are
+            # still invisible to the accounting — flag, never publish
+            # silently
+            row["flops_accounting_hole"] = round(
+                1.0 - v["hw_flops"] / model_flops, 3
+            )
+        ceilings[k] = row
     best = max(c["mfu_ceiling"] for c in ceilings.values())
     rec = emit(
         name,

@@ -29,6 +29,7 @@ import logging
 import os
 import pickle
 import sys
+import threading as _threading
 import time
 from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
@@ -193,6 +194,7 @@ class ProcessGroup:
                 w0.wait()
         else:
             _done()
+        _register_with_active_cm(self, work)
         return out, work
 
     # -- identity ----------------------------------------------------------
@@ -440,6 +442,15 @@ def _maybe_enable_default_watchdog(pg: ProcessGroup) -> None:
     default = "1" if "TDX_AGENT_STORE" in os.environ else "0"
     if os.environ.get("TDX_WATCHDOG", default) == "0":
         return
+    _arm_abort_watchdog(pg)
+
+
+def _arm_abort_watchdog(pg: ProcessGroup) -> None:
+    """Arm the dump-and-abort watchdog on one group. Shared by the
+    default group and every subgroup created while the default watchdog
+    is active — torch's NCCL watchdog covers EVERY ProcessGroupNCCL,
+    so a collective hung on a `new_group` subgroup must be just as
+    visible as one hung on WORLD (round-4 advisor)."""
     timeout_s = float(os.environ.get("TDX_WATCHDOG_TIMEOUT_S", "300"))
 
     def _abort(desc: str, work, dump_path: str) -> None:
@@ -494,6 +505,12 @@ def _new_group_internal(
             driver_mode=_world.mode != "multiproc",
         )
     pg = ProcessGroup(flat, ranks, backend_name, backend, store, name, tsec)
+    # watchdog coverage follows the default group: torch's NCCL watchdog
+    # scans every PG, not just WORLD — a hang on a subgroup collective
+    # must trip detection the same way (round-4 advisor)
+    default_pg = _world.default_pg
+    if default_pg is not None and default_pg.watchdog is not None:
+        _arm_abort_watchdog(pg)
     _world.pg_map[name] = pg
     _world.pg_names[id(pg)] = name
     _world.group_count += 1
@@ -1134,7 +1151,12 @@ class _CoalescingManager:
 
     Under XLA the batching itself is automatic (each collective is an async
     dispatch; XLA overlaps them), so the manager's contract reduces to
-    collecting the works and waiting once."""
+    collecting the works and waiting once. Works are collected
+    AUTOMATICALLY: any collective dispatched on the manager's group while
+    the context is active registers its Work here (torch's context does
+    the same through the group's coalescing state), so `cm.wait()` is a
+    real completion barrier even when the caller discards the per-op
+    returns."""
 
     def __init__(self, group: ProcessGroup):
         self.group = group
@@ -1149,15 +1171,31 @@ class _CoalescingManager:
         self.works = []
 
 
+_active_cms = _threading.local()
+
+
+def _register_with_active_cm(group: ProcessGroup, work: Work) -> None:
+    stack = getattr(_active_cms, "stack", None)
+    if stack:
+        cm = stack[-1]
+        if cm.group is group and work is not None:
+            cm.append(work)
+
+
 @_contextmanager
 def coalescing_manager(group=None, async_ops: bool = False):
     """Batch a series of collectives and wait for them together (torch
     `_coalescing_manager`, `distributed_c10d.py` coalescing context)."""
     g = _resolve(group)
     cm = _CoalescingManager(g)
+    stack = getattr(_active_cms, "stack", None)
+    if stack is None:
+        stack = _active_cms.stack = []
+    stack.append(cm)
     try:
         yield cm
     finally:
+        stack.pop()
         # wait even on the error path so completion callbacks (flight
         # recorder / status) fire and nothing reads as forever-enqueued
         if not async_ops:

@@ -41,6 +41,30 @@ def max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
     return x.max(axis=(2, 4))
 
 
+class _TunedConv(nn.Module):
+    """nn.Conv-compatible VALID conv (same param tree: kernel/bias, same
+    lecun_normal/zeros inits) routed through ops.conv.conv2d_valid_nhwc,
+    whose backward uses the faster schedule per backend. Used only for
+    the SECOND conv: its input gradient is on the backward path, where
+    the custom schedule pays off; the first conv's input is data (no dX
+    exists), and a custom_vjp would compute one anyway."""
+
+    features: int
+    kernel_size: tuple
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.conv import conv2d_valid_nhwc
+
+        k = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (*self.kernel_size, x.shape[-1], self.features),
+        )
+        b = self.param("bias", nn.initializers.zeros_init(), (self.features,))
+        return conv2d_valid_nhwc(x, k) + b
+
+
 class ConvNet(nn.Module):
     num_classes: int = 10
     dropout_rate: float = 0.5
@@ -51,7 +75,9 @@ class ConvNet(nn.Module):
         x = nn.Conv(features=10, kernel_size=(5, 5), padding="VALID")(x)
         x = max_pool_2x2(x)
         x = nn.relu(x)
-        x = nn.Conv(features=20, kernel_size=(5, 5), padding="VALID")(x)
+        # name="Conv_1" keeps the param tree identical to the plain
+        # nn.Conv stack (checkpoint compatibility)
+        x = _TunedConv(features=20, kernel_size=(5, 5), name="Conv_1")(x)
         x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
         x = max_pool_2x2(x)
         x = nn.relu(x)

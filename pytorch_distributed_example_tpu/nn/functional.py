@@ -17,7 +17,7 @@ dense references:
   broadcast(src):   y_i = x_src            dx_src = Σ_i ct_i, else 0
   all_to_all:       transpose of shards    inverse all_to_all
   all_to_all_single: single-tensor chunk exchange (same transpose)
-  reduce(dst):      dst gets Σ_j x_j       dx_j = ct_dst (broadcast from dst)
+  reduce(dst):      dst gets Σ_j x_j, rest keep x_j  dx_j = ct_dst (broadcast)
   gather(dst):      dst gets concat_j x_j  dx_j = ct[j] (scatter from dst)
   scatter(src):     y_i = x_src[i]         dx_src = concat_i ct_i (gather)
 
@@ -143,17 +143,46 @@ def scatter(x, src: int = 0, axis_name: str = "dp", axis: int = 0):
 
 def reduce(x, dst: int = 0, op=ReduceOp.SUM, axis_name: str = "dp"):
     """Differentiable reduce-to-dst (torch `nn.functional.reduce`,
-    `_Reduce`): rank `dst` receives the reduction; other ranks get zeros
-    (SPMD needs a shape-uniform value everywhere — torch returns the
-    input unchanged off-dst, which no ported loss should consume).
-    Backward is the transpose of psum×mask: the cotangent at `dst`
-    broadcasts to every contributing rank — torch `_Reduce.backward`'s
-    broadcast-from-dst semantics."""
+    `_Reduce`): rank `dst` receives the reduction; every other rank gets
+    its INPUT back unchanged — torch's exact off-dst behavior (`_Reduce.
+    forward` returns the in-place-reduced buffer, defined only at dst;
+    ported code reading the off-dst value sees the input, not zeros).
+    Backward is pinned by custom_vjp to `_Reduce.backward`'s semantics
+    regardless of op: the cotangent AT dst broadcasts to every
+    contributing rank; off-dst cotangents are discarded."""
+    global _reduce_vjp
+    if _reduce_vjp is None:  # built lazily: module import stays jax-free
+        _reduce_vjp = _make_reduce_vjp()
+    return _reduce_vjp(dst, _resolve_op(op), axis_name, x)
+
+
+def _reduce_fwd(dst, op, axis_name, x):
     from jax import lax
 
     reduced = all_reduce(x, op, axis_name)
-    mask = (lax.axis_index(axis_name) == dst).astype(x.dtype)
-    return reduced * mask
+    keep = (lax.axis_index(axis_name) == dst).astype(x.dtype)
+    return reduced * keep + x * (1 - keep), None
+
+
+def _reduce_bwd(dst, op, axis_name, _res, ct):
+    from jax import lax
+
+    mask = (lax.axis_index(axis_name) == dst).astype(ct.dtype)
+    return (lax.psum(ct * mask, axis_name),)
+
+
+def _make_reduce_vjp():
+    import jax
+
+    f = jax.custom_vjp(
+        lambda dst, op, axis_name, x: _reduce_fwd(dst, op, axis_name, x)[0],
+        nondiff_argnums=(0, 1, 2),
+    )
+    f.defvjp(_reduce_fwd, _reduce_bwd)
+    return f
+
+
+_reduce_vjp = None
 
 
 def all_to_all_single(x, axis_name: str = "dp", split_axis: int = 0,

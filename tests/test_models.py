@@ -454,3 +454,42 @@ class TestShardedTransformer:
         qk = sharded["params"]["layers_0"]["attn"]["q_proj"]["kernel"]
         # (64, 64) over (fsdp=4, tp=2) -> local (16, 32)
         assert {s.data.shape for s in qk.addressable_shards} == {(16, 32)}
+
+
+class TestTunedConv:
+    """ops/conv.py: the CPU custom-vjp conv must be numerically the SAME
+    convolution as the lax path — value, dX and dW (its backward routes
+    dX through an im2col formulation; a slice-ordering bug there would
+    silently corrupt ConvNet input gradients on CPU while CI stays
+    green)."""
+
+    def test_im2col_equals_direct_and_grads_match(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.ops.conv import (
+            _conv_direct,
+            _conv_im2col,
+            conv2d_valid_nhwc,
+        )
+
+        gen = np.random.default_rng(0)
+        # the ConvNet conv2 geometry plus an asymmetric-spatial case
+        for shape, wshape in (((8, 12, 12, 10), (5, 5, 10, 20)),
+                              ((4, 9, 7, 3), (3, 3, 3, 5))):
+            x = jnp.asarray(gen.standard_normal(shape), jnp.float32)
+            w = jnp.asarray(gen.standard_normal(wshape) * 0.1, jnp.float32)
+            np.testing.assert_allclose(
+                _conv_im2col(x, w), _conv_direct(x, w), atol=1e-4
+            )
+
+            def loss_ref(x, w):
+                return (_conv_direct(x, w) ** 2).sum()
+
+            def loss_tuned(x, w):
+                return (conv2d_valid_nhwc(x, w) ** 2).sum()
+
+            dx_r, dw_r = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+            dx_t, dw_t = jax.grad(loss_tuned, argnums=(0, 1))(x, w)
+            np.testing.assert_allclose(dx_t, dx_r, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(dw_t, dw_r, rtol=1e-4, atol=1e-4)

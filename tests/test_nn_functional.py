@@ -267,9 +267,10 @@ class TestGatherScatter:
 
 class TestReduce:
     def test_value_and_grad_broadcast_from_dst(self, mesh):
-        """torch `_Reduce`: dst holds the SUM, others zeros here (SPMD
-        shape uniformity); grad of a dst-consuming loss broadcasts the
-        cotangent to every contributing rank."""
+        """torch `_Reduce`: dst holds the SUM, every other rank gets its
+        INPUT back unchanged (torch's exact off-dst behavior); grad of a
+        dst-consuming loss broadcasts the cotangent to every contributing
+        rank, and off-dst cotangents are discarded."""
         import jax
         import jax.numpy as jnp
 
@@ -279,11 +280,23 @@ class TestReduce:
 
         f = _shard_mapped(lambda x: F.reduce(x, dst, ReduceOp.SUM, "dp"), mesh)
         y = np.asarray(f(x)).reshape(W, n, x.shape[1])
-        want = np.asarray(x).reshape(W, n, x.shape[1]).sum(axis=0)
+        xb = np.asarray(x).reshape(W, n, x.shape[1])
+        want = xb.sum(axis=0)
         np.testing.assert_allclose(y[dst], want, rtol=1e-5)
         for r in range(W):
             if r != dst:
-                assert np.abs(y[r]).sum() == 0
+                np.testing.assert_allclose(y[r], xb[r], rtol=1e-6)
+
+        # off-dst cotangents are discarded (torch _Reduce.backward only
+        # broadcasts the dst gradient)
+        off = (dst + 1) % W
+
+        def loss_offdst(x):
+            out = f(x).reshape(W, n, x.shape[1])
+            return (out[off] ** 2).sum()
+
+        g_off = np.asarray(jax.grad(loss_offdst)(x))
+        assert np.abs(g_off).sum() == 0
 
         def loss(x):
             out = f(x).reshape(W, n, x.shape[1])

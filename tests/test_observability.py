@@ -95,6 +95,29 @@ class TestWatchdog:
         assert trips and trips[0][0] == "test:hung:1"
         assert trips[0][1]  # dump path written
 
+    def test_subgroup_inherits_watchdog_coverage(self, world):
+        """A collective hung on a `new_group` subgroup must be visible to
+        hang detection, as torch's NCCL watchdog covers every PG, not
+        just WORLD (round-4 advisor). Arming the default group makes
+        groups created afterwards arm themselves."""
+        import pytorch_distributed_example_tpu as tdx
+        from pytorch_distributed_example_tpu import distributed as dist
+
+        assert world.watchdog is None  # precondition: not armed by env
+        try:
+            dist._arm_abort_watchdog(world)
+            sub = tdx.new_group(list(range(world.size()))[:2])
+            assert sub.watchdog is not None, (
+                "subgroup created under an armed default watchdog must "
+                "be scanned too"
+            )
+            tdx.destroy_process_group(sub)
+            assert sub.watchdog is None  # destroy stops the scanner
+        finally:
+            if world.watchdog is not None:
+                world.watchdog.stop()
+                world.watchdog = None
+
     def test_completed_work_not_flagged(self):
         from pytorch_distributed_example_tpu.types import CompletedWork
         from pytorch_distributed_example_tpu.utils.watchdog import Watchdog

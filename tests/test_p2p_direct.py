@@ -214,3 +214,161 @@ def test_dist_routing_falls_back_to_store_when_peer_opted_out(planes):
     finally:
         dist._p2p_plane = saved
         a.close()
+
+
+def test_inbox_backpressure_bounds_buffered_bytes(planes, monkeypatch):
+    """Round-4 verdict #5: a sender streaming faster than the receiver
+    drains must NOT balloon receiver memory — the reader parks over the
+    high-water mark and TCP flow control throttles the sender. The
+    invariant: bytes parked in the inbox never exceed HWM + one frame."""
+    from pytorch_distributed_example_tpu import p2p as p2p_mod
+
+    monkeypatch.setattr(p2p_mod, "_INBOX_HWM", 1 << 20)  # 1 MB
+    a, b = planes(0), planes(1)
+    frame = np.ones(1 << 18, np.float32)  # 1 MB frames
+    n = 24
+
+    def sender():
+        for i in range(n):
+            a.send(1, "bp", 0, i, frame, 30.0)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    # while the sender streams, the parked bytes must stay bounded
+    peak = 0
+    deadline = time.monotonic() + 20
+    while t.is_alive() and time.monotonic() < deadline:
+        with b._cond:
+            parked = sum(
+                v[3].nbytes for v in b._inbox.values()
+            )
+        peak = max(peak, parked)
+        assert parked <= (1 << 20) + frame.nbytes, (
+            f"inbox ballooned to {parked} bytes"
+        )
+        time.sleep(0.01)
+    # drain: every frame arrives intact and in order, sender finishes
+    for i in range(n):
+        got = b.recv(0, "bp", 0, i, 30.0)
+        assert np.array_equal(got, frame)
+    t.join(30)
+    assert not t.is_alive()
+    assert peak > 0  # the probe actually observed parked frames
+
+
+def test_reader_rejects_oversized_header_fields(planes):
+    """Struct framing (round-4 advisor): garbage or hostile headers are
+    rejected by validation before any allocation, and the connection is
+    dropped without crashing the plane."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    from pytorch_distributed_example_tpu.p2p import _FHDR, _HELLO
+
+    a, b = planes(0), planes(1)
+    ep = a.endpoint_of(1, 5.0)
+    s = socket_mod.create_connection(ep, timeout=5.0)
+    try:
+        s.sendall(_HELLO.pack(7))
+        # ndim=200 > _MAX_NDIM: must be rejected before reading dims
+        s.sendall(_FHDR.pack(1, 0, 0, 0, 200, 1, 8))
+        s.sendall(b"rd" + b"\x00" * 8)
+        # the reader closes the connection on validation failure (FIN if
+        # it consumed our bytes, RST if unread data remained)
+        s.settimeout(5.0)
+        try:
+            assert s.recv(1) == b""
+        except ConnectionResetError:
+            pass
+    finally:
+        s.close()
+    # the plane itself is still healthy for well-formed peers
+    a.send(1, "ok", 0, 0, np.array([1.0]), 10.0)
+    assert b.recv(0, "ok", 0, 0, 10.0)[0] == 1.0
+
+
+def test_reader_prunes_connection_state(planes):
+    """Reconnect churn must not grow _in_conns/_readers monotonically
+    (round-4 verdict #5 cosmetic)."""
+    a, b = planes(0), planes(1)
+    a.send(1, "pr", 0, 0, np.array([1.0]), 10.0)
+    b.recv(0, "pr", 0, 0, 10.0)
+    # kill a's outbound socket; b's reader must prune itself
+    with a._peer_lock(1):
+        sock = a._out.pop(1)
+        sock.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with b._cond:
+            if not b._in_conns and not b._readers:
+                break
+        time.sleep(0.05)
+    with b._cond:
+        assert not b._in_conns and not b._readers
+    # reconnect works and state stays at one connection
+    a.send(1, "pr", 0, 1, np.array([2.0]), 10.0)
+    assert b.recv(0, "pr", 0, 1, 10.0)[0] == 2.0
+    with b._cond:
+        assert len(b._in_conns) == 1 and len(b._readers) == 1
+
+
+def test_plane_across_distinct_loopback_addresses():
+    """Two-'host' proof for the data plane (round-4 verdict #6): each
+    plane binds and advertises its OWN 127/8 address, so connections
+    must be dialed at the address the peer PUBLISHED — the store-
+    rendezvous/advertise/dial logic crosses a real address boundary,
+    not the 127.0.0.1 default everything else in this file uses."""
+    import socket as socket_mod
+
+    st = HashStore(30.0)
+    a = P2PPlane(0, st, bind_host="127.0.0.2", advertise="127.0.0.2").start()
+    b = P2PPlane(1, st, bind_host="127.0.0.3", advertise="127.0.0.3").start()
+    try:
+        x = np.arange(1 << 16, dtype=np.float32)
+        a.send(1, "xh", 0, 0, x, 10.0)
+        got = b.recv(0, "xh", 0, 0, 10.0)
+        assert np.array_equal(got, x)
+        b.send(0, "xh", 0, 0, x * 2, 10.0)
+        assert np.array_equal(a.recv(1, "xh", 0, 0, 10.0), x * 2)
+        # the sender really dialed the advertised cross-"host" address
+        assert a._out[1].getpeername()[0] == "127.0.0.3"
+        assert b._out[0].getpeername()[0] == "127.0.0.2"
+        # and the listener is NOT reachable at the default loopback —
+        # the addresses are genuinely distinct endpoints
+        port_b = b._listener.getsockname()[1]
+        with pytest.raises(OSError):
+            socket_mod.create_connection(("127.0.0.1", port_b), timeout=1.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_backpressure_does_not_block_starved_receiver(planes, monkeypatch):
+    """Head-of-line guard: a receiver waiting for a LATER frame must not
+    deadlock against the high-water mark when earlier unconsumed frames
+    already fill the inbox — readers keep reading while any recv is
+    starved (the wanted frame may sit behind the backlog on the same
+    socket), matching torch/gloo's unmatched-message buffering."""
+    from pytorch_distributed_example_tpu import p2p as p2p_mod
+
+    monkeypatch.setattr(p2p_mod, "_INBOX_HWM", 1 << 20)  # 1 MB
+    a, b = planes(0), planes(1)
+    big = np.ones(1 << 18, np.float32)  # 1 MB
+    for i in range(4):  # 4 MB of tag-1 backlog, far over the mark
+        a.send(1, "hol", 1, i, big, 30.0)
+    a.send(1, "hol", 2, 0, np.array([9.0], np.float32), 30.0)
+    # recv the LAST frame first: the reader must push past the HWM to
+    # reach it while this recv waits
+    assert b.recv(0, "hol", 2, 0, 30.0)[0] == 9.0
+    for i in range(4):
+        assert np.array_equal(b.recv(0, "hol", 1, i, 30.0), big)
+
+
+def test_tag_seq_range_validation(planes):
+    """The struct wire pins tag to i32 / seq to i64; out-of-range values
+    get a curated ValueError, not a raw struct.error mid-send."""
+    a, _b = planes(0), planes(1)
+    with pytest.raises(ValueError, match="int32"):
+        a.send(1, "rng", 2**31, 0, np.array([1.0]), 5.0)
+    with pytest.raises(ValueError, match="int64"):
+        a.send(1, "rng", 0, 2**63, np.array([1.0]), 5.0)
