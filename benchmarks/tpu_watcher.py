@@ -33,9 +33,34 @@ STATE = os.path.join(ROOT, "benchmarks", "tpu_watcher_state.json")
 TRACE_DIR = os.path.join("benchmarks", "traces", "tpu_r04")
 
 # (name, argv, extra_env, timeout_s, commit_paths). Ordered by value per
-# minute of tunnel time: the driver's north-star headline first, then
-# MFU, then tuning sweeps, then the trace, then the full sweep.
+# minute of tunnel time. Round-5 window #1 lasted <20 min and the full
+# headline bench burned all of it before timing out — so the battery now
+# front-loads a <2-minute quick proof (self-watchdogged: a wedged device
+# op exits in seconds, not at the step timeout) and a shortened headline
+# before the full-length runs.
 BATTERY = [
+    (
+        "quick_proof",
+        [sys.executable, "benchmarks/tpu_quick_proof.py"],
+        {},
+        420,
+        ["benchmarks/results.json", "BENCH_WATCHER.json"],
+    ),
+    (
+        "headline_short",
+        [sys.executable, "bench.py"],
+        {
+            "BENCH_WINDOW_S": "0",
+            "BENCH_INIT_TRIES": "1",
+            "BENCH_PROBE_TIMEOUT": "60",
+            "BENCH_WARMUP": "5",
+            "BENCH_STEPS": "60",
+            "BENCH_MFU_WARMUP": "2",
+            "BENCH_MFU_STEPS": "10",
+        },
+        600,
+        ["benchmarks/results.json", "BENCH_WATCHER.json"],
+    ),
     (
         "headline",
         [sys.executable, "bench.py"],
@@ -48,20 +73,20 @@ BATTERY = [
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
     ),
     (
-        "llama_mfu_1b",
-        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"],
-        {},
-        2400,
-        ["benchmarks/results.json"],
-    ),
-    (
         # the AOT roofline says no-remat is compute-bound with headroom
-        # (ceiling 1.15 vs 0.93) and fits 15.3 GB < 16 GB — likely the
-        # best single-chip MFU configuration
+        # and fits 15.3 GB < 16 GB — likely the best single-chip MFU
+        # configuration, so it runs before the remat variant
         "llama_mfu_1b_noremat",
         [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
          "--no-remat"],
         {"TDX_MFU_KEY_SUFFIX": "_noremat"},
+        2400,
+        ["benchmarks/results.json"],
+    ),
+    (
+        "llama_mfu_1b",
+        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"],
+        {},
         2400,
         ["benchmarks/results.json"],
     ),
