@@ -330,7 +330,7 @@ def _bench_ddp_mnist(jax, tdx):
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
 
-    return steps * global_batch / dt / world
+    return steps * global_batch / dt / world, {"warmup": warmup, "steps": steps}
 
 
 def _bench_mfu(jax, is_tpu: bool):
@@ -483,6 +483,33 @@ def _mfu_breakdown(jax, model, params, toks, steps, step_s):
     return out
 
 
+def _committed_tpu_rows():
+    """Compact {key: {value, unit, measured_at}} summary of platform=tpu
+    rows already committed in benchmarks/results.json, for the CPU
+    fallback line. Returns None when there are none."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "results.json"
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception:
+        return None
+    rows = {}
+    for key, entry in (doc.get("results") or {}).items():
+        r = entry.get("result") if isinstance(entry, dict) else None
+        if not isinstance(r, dict):
+            continue
+        if str(r.get("platform", "")).lower() not in ("tpu", "axon"):
+            continue
+        rows[key] = {
+            k: r[k]
+            for k in ("metric", "value", "unit", "mfu", "measured_at")
+            if k in r
+        }
+    return rows or None
+
+
 def _persist_tpu_result(out: dict):
     """Merge a successful TPU headline into benchmarks/results.json and
     best-effort git-commit it, so one good tunnel window leaves durable,
@@ -491,18 +518,29 @@ def _persist_tpu_result(out: dict):
 
     root = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(root, "benchmarks", "results.json")
+    # BENCH_HEADLINE_KEY lets a shortened run (the watcher's
+    # headline_short step) land under its own key instead of silently
+    # clobbering a committed full-length row.
+    key = os.environ.get("BENCH_HEADLINE_KEY", "headline")
     doc = {"results": {}}
     if os.path.exists(path):
         try:
             with open(path) as f:
                 doc = json.load(f)
         except Exception:
-            pass
+            # never discard other rows on a corrupt file: set the bytes
+            # aside for forensics and start a fresh doc
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
     doc.setdefault("results", {})
-    doc["results"]["headline"] = {"rc": 0, "result": dict(out)}
+    doc["results"][key] = {"rc": 0, "result": dict(out)}
     doc["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
     if os.environ.get("BENCH_AUTOCOMMIT", "1") != "0":
         try:
             subprocess.run(
@@ -560,7 +598,7 @@ def main():
         tdx.init_process_group(backend="xla")
 
         phase = "ddp_mnist"
-        per_chip = _bench_ddp_mnist(jax, tdx)
+        per_chip, run_meta = _bench_ddp_mnist(jax, tdx)
 
         phase = "mfu"
         try:
@@ -588,6 +626,9 @@ def main():
             "value": round(per_chip, 1),
             "unit": "samples/s/chip",
             "world": tdx.get_world_size(),
+            "warmup": run_meta["warmup"],
+            "steps": run_meta["steps"],
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "vs_baseline": round(vs, 3),
             "mfu": round(mfu, 4),
             "mfu_tflops": round(achieved_tflops, 2),
@@ -597,6 +638,14 @@ def main():
         }
         if platform == "cpu" and cpu_flags:
             out["cpu_flags"] = cpu_flags
+        if platform == "cpu":
+            # The CPU fallback line should still carry the pointer to any
+            # committed platform=tpu measurements (the tunnel flaps on
+            # minute timescales; evidence landed in an earlier window must
+            # be discoverable from this one JSON line).
+            tpu_rows = _committed_tpu_rows()
+            if tpu_rows:
+                out["committed_tpu_evidence"] = tpu_rows
         out.update(flash_info)
         if init_errors:
             # a 20-min poll window can log dozens of probe attempts; keep

@@ -57,6 +57,7 @@ BATTERY = [
             "BENCH_STEPS": "60",
             "BENCH_MFU_WARMUP": "2",
             "BENCH_MFU_STEPS": "10",
+            "BENCH_HEADLINE_KEY": "headline_short",
         },
         600,
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
