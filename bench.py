@@ -319,7 +319,9 @@ def _bench_ddp_mnist(jax, tdx):
         p, opt_state, loss = step(p, opt_state, x, y, keys[i])
         if sync_stride and (i + 1) % sync_stride == 0:
             jax.block_until_ready(loss)
+            _tick("ddp_mnist_warmup")
     jax.block_until_ready(loss)
+    _tick("ddp_mnist_warmed")
 
     with _maybe_trace(jax):
         t0 = time.perf_counter()
@@ -327,8 +329,10 @@ def _bench_ddp_mnist(jax, tdx):
             p, opt_state, loss = step(p, opt_state, x, y, keys[warmup + i])
             if sync_stride and (i + 1) % sync_stride == 0:
                 jax.block_until_ready(loss)
+                _tick("ddp_mnist_timed")
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
+    _tick("ddp_mnist_done")
 
     return steps * global_batch / dt / world, {"warmup": warmup, "steps": steps}
 
@@ -403,9 +407,11 @@ def _bench_mfu(jax, is_tpu: bool):
             "flash_used": False,
             "flash_error": f"{type(e).__name__}: {str(e)[:300]}",
         }
+        _tick("mfu_flash_failed")
         step, params, opt_state, toks, model = build(use_flash=False)
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
+    _tick("mfu_compiled")
 
     # Analytic model FLOPs per step: fwd 2 x (6N+12*l*d*L is already the
     # fwd+bwd (3x) multiple of the 2N-per-token forward in the PaLM form).
@@ -427,11 +433,13 @@ def _bench_mfu(jax, is_tpu: bool):
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
+    _tick("mfu_warmed")
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    _tick("mfu_timed")
 
     achieved = model_flops_per_step * steps / dt
     hfu = (hw_flops_per_step * steps / dt / peak) if hw_flops_per_step else 0.0
@@ -502,9 +510,12 @@ def _committed_tpu_rows():
             continue
         if str(r.get("platform", "")).lower() not in ("tpu", "axon"):
             continue
+        if r.get("error"):
+            continue  # a wedge-dump row is not evidence
         rows[key] = {
             k: r[k]
-            for k in ("metric", "value", "unit", "mfu", "measured_at")
+            for k in ("metric", "value", "unit", "mfu", "measured_at",
+                      "steps", "partial")
             if k in r
         }
     return rows or None
@@ -557,6 +568,76 @@ def _persist_tpu_result(out: dict):
             pass  # persistence to disk already succeeded
 
 
+class _WedgeWatchdog:
+    """Opt-in (BENCH_WEDGE_BUDGET=<seconds>) per-phase hang breaker.
+
+    A dying tunnel makes a device op BLOCK inside PJRT with no exception;
+    without this, a wedge mid-MFU burns the caller's whole step timeout
+    AND loses the already-measured headline number. The main thread calls
+    tick(phase[, partial]) at each phase boundary; if no tick arrives
+    within the budget, the watchdog persists whatever partial TPU result
+    exists, prints a parseable diagnostic line, and force-exits rc=3 so
+    the enclosing battery can retry within the same tunnel window."""
+
+    def __init__(self):
+        import threading
+
+        try:
+            self.budget = float(os.environ.get("BENCH_WEDGE_BUDGET", "0") or 0)
+        except ValueError:
+            self.budget = 0.0
+        self._last = time.monotonic()
+        self._phase = "init"
+        self._partial = None
+        self._is_tpu = False
+        self._lock = threading.Lock()
+        if self.budget > 0:
+            threading.Thread(target=self._scan, daemon=True).start()
+
+    def tick(self, phase, partial=None, is_tpu=None):
+        with self._lock:
+            self._phase = phase
+            self._last = time.monotonic()
+            if partial is not None:
+                self._partial = dict(partial)
+            if is_tpu is not None:
+                self._is_tpu = is_tpu
+
+    def _scan(self):
+        while True:
+            time.sleep(5)
+            with self._lock:
+                idle = time.monotonic() - self._last
+                phase, partial, is_tpu = self._phase, self._partial, self._is_tpu
+            if idle > self.budget:
+                out = dict(partial or {})
+                out.setdefault("metric", "ddp_mnist_samples_per_sec_per_chip")
+                out.setdefault("value", 0)
+                out.setdefault("unit", "samples/s/chip")
+                out["error"] = (
+                    f"phase {phase!r} wedged >{self.budget:.0f}s (tunnel died?)"
+                )
+                if is_tpu and partial and partial.get("value"):
+                    try:
+                        _persist_tpu_result(out)
+                    except Exception:
+                        pass
+                print(json.dumps(out), flush=True)
+                os._exit(3)
+
+
+_WDOG = None
+
+
+def _tick(phase: str) -> None:
+    """Milestone tick from inside a bench phase (no-op without a watchdog).
+    Ticks land at blocking-call boundaries (post-compile, post-warmup,
+    post-timed-loop) so a legitimately long phase keeps feeding the
+    watchdog while a wedged device op stops the clock."""
+    if _WDOG is not None:
+        _WDOG.tick(phase)
+
+
 class _maybe_trace:
     """Optional jax.profiler.trace wrapper: BENCH_TRACE=<dir> saves the
     timed loop's device timeline (§5.1 tier 3). Trace dirs are
@@ -581,8 +662,10 @@ class _maybe_trace:
 
 
 def main():
+    global _WDOG
     phase = "jax_init"
     init_errors = None
+    wdog = _WDOG = _WedgeWatchdog()
     try:
         cpu_flags = _apply_cpu_perf_flags()
         jax, devs, init_errors = _acquire_jax(
@@ -595,18 +678,41 @@ def main():
         phase = "init_process_group"
         import pytorch_distributed_example_tpu as tdx
 
+        wdog.tick(phase, is_tpu=is_tpu)
         tdx.init_process_group(backend="xla")
 
         phase = "ddp_mnist"
+        wdog.tick(phase)
         per_chip, run_meta = _bench_ddp_mnist(jax, tdx)
 
         phase = "mfu"
+        partial = {
+            "metric": "ddp_mnist_samples_per_sec_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "samples/s/chip",
+            "world": tdx.get_world_size(),
+            "warmup": run_meta["warmup"],
+            "steps": run_meta["steps"],
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "platform": platform,
+            "device_kind": device_kind,
+            "partial": "mfu phase pending",
+        }
+        wdog.tick(phase, partial=partial)
+        if is_tpu:
+            # the headline number must survive a tunnel death during the
+            # (minutes-long) MFU compiles that follow
+            try:
+                _persist_tpu_result(partial)
+            except Exception:
+                pass
         try:
             mfu, achieved_tflops, hfu, flash_info = _bench_mfu(jax, is_tpu)
         except Exception as e:  # MFU is secondary; never lose the headline
             mfu, achieved_tflops, hfu = 0.0, 0.0, 0.0
             flash_info = {"flash_used": False, "flash_error": "mfu bench failed"}
             init_errors = (init_errors or []) + [f"mfu: {type(e).__name__}: {e}"]
+        wdog.tick("report")
 
         baseline_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
@@ -621,21 +727,13 @@ def main():
             if ref:
                 vs = per_chip / ref
 
-        out = {
-            "metric": "ddp_mnist_samples_per_sec_per_chip",
-            "value": round(per_chip, 1),
-            "unit": "samples/s/chip",
-            "world": tdx.get_world_size(),
-            "warmup": run_meta["warmup"],
-            "steps": run_meta["steps"],
-            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
-            "vs_baseline": round(vs, 3),
-            "mfu": round(mfu, 4),
-            "mfu_tflops": round(achieved_tflops, 2),
-            "hfu": round(hfu, 4),
-            "platform": platform,
-            "device_kind": device_kind,
-        }
+        out = {k: v for k, v in partial.items() if k != "partial"}
+        out.update(
+            vs_baseline=round(vs, 3),
+            mfu=round(mfu, 4),
+            mfu_tflops=round(achieved_tflops, 2),
+            hfu=round(hfu, 4),
+        )
         if platform == "cpu" and cpu_flags:
             out["cpu_flags"] = cpu_flags
         if platform == "cpu":

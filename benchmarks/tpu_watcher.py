@@ -58,6 +58,7 @@ BATTERY = [
             "BENCH_MFU_WARMUP": "2",
             "BENCH_MFU_STEPS": "10",
             "BENCH_HEADLINE_KEY": "headline_short",
+            "BENCH_WEDGE_BUDGET": "240",
         },
         600,
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
@@ -69,6 +70,7 @@ BATTERY = [
             "BENCH_WINDOW_S": "0",
             "BENCH_INIT_TRIES": "1",
             "BENCH_PROBE_TIMEOUT": "60",
+            "BENCH_WEDGE_BUDGET": "420",
         },
         1200,
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
@@ -144,6 +146,8 @@ BATTERY = [
             "BENCH_WARMUP": "10",
             "BENCH_MFU_STEPS": "5",
             "BENCH_MFU_WARMUP": "1",
+            "BENCH_WEDGE_BUDGET": "300",
+            "BENCH_HEADLINE_KEY": "headline_traced",
         },
         1200,
         ["benchmarks/results.json"],  # trace dir force-added separately
@@ -151,7 +155,9 @@ BATTERY = [
     (
         "run_all",
         [sys.executable, "benchmarks/run_all.py"],
-        {},
+        # propagates to the bench.py children run_all spawns; the other
+        # children rely on run_all's own per-job timeouts
+        {"BENCH_WEDGE_BUDGET": "420"},
         5400,
         ["benchmarks/results.json"],
     ),
