@@ -33,15 +33,78 @@ def persist_result(name: str, record: dict) -> None:
         try:
             with open(path) as f:
                 loaded = json.load(f)
-            if isinstance(loaded, dict):  # tolerate a torn/foreign file
+            if isinstance(loaded, dict):  # tolerate a foreign file shape
                 doc = loaded
         except Exception:
-            pass
+            # torn write (a killed bench process): keep the bytes for
+            # forensics rather than replacing every row with {}
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
     doc.setdefault("results", {})
     doc["results"][name] = {"rc": 0, "result": record}
     doc["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+
+
+_WEDGE = None
+
+
+class _Wedge:
+    """Force-exit hang breaker for tunnel-backed TPU benches.
+
+    A dying tunnel BLOCKS a device op inside PJRT (no exception); only
+    process death breaks the grip, and the enclosing battery's step
+    timeout can be 40 minutes. Benches tick() at blocking-call
+    boundaries; if no tick lands within the budget, print a parseable
+    diagnostic and exit rc=3 so the battery retries/moves on fast."""
+
+    def __init__(self, budget_s: float):
+        import threading
+
+        self.budget_s = budget_s
+        self._last = time.monotonic()
+        self._phase = "start"
+        threading.Thread(target=self._scan, daemon=True).start()
+
+    def tick(self, phase: str) -> None:
+        self._phase = phase
+        self._last = time.monotonic()
+
+    def _scan(self) -> None:
+        while True:
+            time.sleep(5)
+            if time.monotonic() - self._last > self.budget_s:
+                print(json.dumps({
+                    "error": f"phase {self._phase!r} wedged "
+                             f">{self.budget_s:.0f}s (tunnel died?)",
+                }), flush=True)
+                os._exit(3)
+
+
+def arm_wedge(default_budget_s: float = 0.0):
+    """Arm the shared wedge watchdog from BENCH_WEDGE_BUDGET (seconds;
+    0/unset/malformed = disabled unless a default is given)."""
+    global _WEDGE
+    try:
+        budget = float(
+            os.environ.get("BENCH_WEDGE_BUDGET", str(default_budget_s)) or 0
+        )
+    except ValueError:
+        budget = default_budget_s
+    if budget > 0 and _WEDGE is None:
+        _WEDGE = _Wedge(budget)
+    return _WEDGE
+
+
+def wtick(phase: str) -> None:
+    """Milestone tick (no-op when the watchdog is not armed)."""
+    if _WEDGE is not None:
+        _WEDGE.tick(phase)
 
 
 def on_tpu() -> bool:

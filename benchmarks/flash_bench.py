@@ -38,9 +38,11 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from benchmarks.common import emit
+    from benchmarks.common import arm_wedge, emit, wtick
     from pytorch_distributed_example_tpu.ops import flash_attention
     from pytorch_distributed_example_tpu.ops.reference import dense_attention
+
+    arm_wedge()  # honor BENCH_WEDGE_BUDGET: fail fast if the tunnel dies
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     gen = np.random.default_rng(0)
@@ -52,10 +54,12 @@ def main():
     def timed(fn):
         out = fn()  # compile
         jax.block_until_ready(out)
+        wtick("sweep_compiled")
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = fn()
         jax.block_until_ready(out)
+        wtick("sweep_timed")
         return (time.perf_counter() - t0) / args.iters * 1e3  # ms
 
     cands = [int(b) for b in args.blocks.split(",") if args.seq % int(b) == 0]

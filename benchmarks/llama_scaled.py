@@ -102,7 +102,9 @@ def run_mfu(args):
         return
 
     from bench import _peak_flops  # spec-sheet bf16 peaks
+    from benchmarks.common import arm_wedge, wtick
 
+    arm_wedge()  # honor BENCH_WEDGE_BUDGET: fail fast if the tunnel dies
     peak = _peak_flops(kind)
     B, L = args.batch, args.seq
     # remat trades MFU for memory; ~1B bf16 states (~7.6 GB) may leave
@@ -132,15 +134,19 @@ def run_mfu(args):
         updates, opt_state2 = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state2, loss
 
+    wtick("mfu_init_done")
     params, opt_state, loss = step(params, opt_state, toks)  # compile
     jax.block_until_ready(loss)
+    wtick("mfu_compiled")
     for _ in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
+    wtick("mfu_warmed")
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, loss = step(params, opt_state, toks)
     jax.block_until_ready(loss)
+    wtick("mfu_timed")
     dt = (time.perf_counter() - t0) / args.steps
 
     flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)

@@ -82,14 +82,14 @@ BATTERY = [
         "llama_mfu_1b_noremat",
         [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
          "--no-remat"],
-        {"TDX_MFU_KEY_SUFFIX": "_noremat"},
+        {"TDX_MFU_KEY_SUFFIX": "_noremat", "BENCH_WEDGE_BUDGET": "1200"},
         2400,
         ["benchmarks/results.json"],
     ),
     (
         "llama_mfu_1b",
         [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"],
-        {},
+        {"BENCH_WEDGE_BUDGET": "1200"},
         2400,
         ["benchmarks/results.json"],
     ),
@@ -100,7 +100,7 @@ BATTERY = [
             "--seq", "512", "--dh", "64", "--bf16", "--causal",
             "--blocks", "128,256,512",
         ],
-        {},
+        {"BENCH_WEDGE_BUDGET": "600"},
         1800,
         ["benchmarks/results.json"],
     ),
@@ -111,7 +111,7 @@ BATTERY = [
             "--seq", "1024", "--dh", "128", "--bf16", "--causal",
             "--blocks", "128,256,512",
         ],
-        {},
+        {"BENCH_WEDGE_BUDGET": "600"},
         1800,
         ["benchmarks/results.json"],
     ),
