@@ -173,6 +173,9 @@ def _acquire_jax(max_tries: int = 3, backoff: float = 5.0):
     attempt = 0
     while True:
         attempt += 1
+        # the window poll is legitimate liveness (killable-subprocess
+        # probes), not a wedge — keep feeding the watchdog
+        _tick("jax_init_probe")
         probe_ok, err = _probe_backend_subprocess(probe_timeout)
         if probe_ok:
             ok, result = _init_inprocess(errors, probe_timeout)
@@ -582,8 +585,15 @@ class _WedgeWatchdog:
     def __init__(self):
         import threading
 
+        # Default ON at 900s: ticks land at blocking-call boundaries, and
+        # no legitimate single blocking call (one compile, one timed
+        # loop segment) approaches 15 minutes — but a wedged tunnel
+        # otherwise turns the driver's end-of-round run into rc=124 with
+        # no JSON line. BENCH_WEDGE_BUDGET=0 disables.
         try:
-            self.budget = float(os.environ.get("BENCH_WEDGE_BUDGET", "0") or 0)
+            self.budget = float(
+                os.environ.get("BENCH_WEDGE_BUDGET", "900") or 0
+            )
         except ValueError:
             self.budget = 0.0
         self._last = time.monotonic()

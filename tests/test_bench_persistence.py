@@ -129,6 +129,11 @@ class TestWedgeWatchdogConfig:
         w = bench_mod._WedgeWatchdog()
         assert w.budget == 0.0
 
-    def test_unset_disables(self, bench_mod, monkeypatch):
+    def test_default_on_at_900(self, bench_mod, monkeypatch):
+        # the driver's end-of-round run must never wedge silently
         monkeypatch.delenv("BENCH_WEDGE_BUDGET", raising=False)
+        assert bench_mod._WedgeWatchdog().budget == 900.0
+
+    def test_zero_disables(self, bench_mod, monkeypatch):
+        monkeypatch.setenv("BENCH_WEDGE_BUDGET", "0")
         assert bench_mod._WedgeWatchdog().budget == 0.0
