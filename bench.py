@@ -572,7 +572,8 @@ def _persist_tpu_result(out: dict):
 
 
 class _WedgeWatchdog:
-    """Opt-in (BENCH_WEDGE_BUDGET=<seconds>) per-phase hang breaker.
+    """Default-ON (900 s) per-phase hang breaker; BENCH_WEDGE_BUDGET
+    overrides the budget and 0 disables it.
 
     A dying tunnel makes a device op BLOCK inside PJRT with no exception;
     without this, a wedge mid-MFU burns the caller's whole step timeout
@@ -580,28 +581,49 @@ class _WedgeWatchdog:
     tick(phase[, partial]) at each phase boundary; if no tick arrives
     within the budget, the watchdog persists whatever partial TPU result
     exists, prints a parseable diagnostic line, and force-exits rc=3 so
-    the enclosing battery can retry within the same tunnel window."""
+    the enclosing battery can retry within the same tunnel window.
+    NOTE: ticks land at blocking-call boundaries, so a single legitimate
+    blocking call longer than the budget (e.g. absurd BENCH_STEPS on a
+    slow chip) needs BENCH_WEDGE_BUDGET raised accordingly; the budget
+    self-clamps above BENCH_PROBE_TIMEOUT so probe windows are safe."""
 
-    def __init__(self):
-        import threading
+    DEFAULT_BUDGET_S = 900.0
 
-        # Default ON at 900s: ticks land at blocking-call boundaries, and
-        # no legitimate single blocking call (one compile, one timed
-        # loop segment) approaches 15 minutes — but a wedged tunnel
-        # otherwise turns the driver's end-of-round run into rc=124 with
-        # no JSON line. BENCH_WEDGE_BUDGET=0 disables.
+    @staticmethod
+    def _parse_budget() -> float:
+        """Resolve the effective budget without side effects.
+
+        Malformed values fall back to the DEFAULT (not to disabled —
+        a typo must not silently recreate the wedge-forever failure
+        this watchdog exists to prevent); the result is clamped above
+        the probe timeout + margin so a legitimately long init probe
+        can never trip it."""
         try:
-            self.budget = float(
-                os.environ.get("BENCH_WEDGE_BUDGET", "900") or 0
+            budget = float(
+                os.environ.get(
+                    "BENCH_WEDGE_BUDGET", str(_WedgeWatchdog.DEFAULT_BUDGET_S)
+                )
             )
         except ValueError:
-            self.budget = 0.0
+            budget = _WedgeWatchdog.DEFAULT_BUDGET_S
+        if budget <= 0:
+            return 0.0
+        try:
+            probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+        except ValueError:
+            probe_timeout = 120.0
+        return max(budget, probe_timeout + 120.0)
+
+    def __init__(self, start_thread: bool = True):
+        import threading
+
+        self.budget = self._parse_budget()
         self._last = time.monotonic()
         self._phase = "init"
         self._partial = None
         self._is_tpu = False
         self._lock = threading.Lock()
-        if self.budget > 0:
+        if self.budget > 0 and start_thread:
             threading.Thread(target=self._scan, daemon=True).start()
 
     def tick(self, phase, partial=None, is_tpu=None):

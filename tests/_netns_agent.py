@@ -1,0 +1,60 @@
+"""Elastic-agent process for the netns LAN test (tests/test_netns_hosts.py).
+
+Runs INSIDE a network namespace. Builds the same WorkerSpec the elastic
+tests use (fast heartbeats, tmp-dir worker that reports its (gen, world,
+rank) by touching files), points master_addr at the rendezvous host's
+bridge address and advertises its own, then runs LocalElasticAgent to
+completion. Emits one JSON line so the orchestrating test can assert on
+state/failovers/active-master across REAL separate network stacks.
+
+argv: node_rank nnodes min_nnodes master_ip my_ip port out_dir worker_py
+"""
+
+import json
+import sys
+
+from pytorch_distributed_example_tpu.elastic.agent import (
+    LocalElasticAgent,
+    WorkerSpec,
+)
+
+
+def main() -> int:
+    node_rank = int(sys.argv[1])
+    nnodes = int(sys.argv[2])
+    min_nnodes = int(sys.argv[3])
+    master_ip = sys.argv[4]
+    my_ip = sys.argv[5]
+    port = int(sys.argv[6])
+    out_dir = sys.argv[7]
+    worker_py = sys.argv[8]
+
+    spec = WorkerSpec(
+        entrypoint=[worker_py],
+        nproc_per_node=1,
+        nnodes=nnodes,
+        min_nnodes=min_nnodes,
+        node_rank=node_rank,
+        master_addr=master_ip,
+        master_port=port,
+        advertise_addr=my_ip,
+        monitor_interval_s=0.05,
+        node_settle_s=0.5,
+        heartbeat_timeout_s=2.0,
+        max_restarts=3,
+        env={"OUT_DIR": out_dir},
+    )
+    agent = LocalElasticAgent(spec)
+    result = agent.run()
+    print(json.dumps({
+        "node": node_rank,
+        "state": result.state.name,
+        "failovers": getattr(agent, "failovers", 0),
+        "active_master": list(agent._active_master),
+        "members": sorted(getattr(agent, "members", []) or []),
+    }), flush=True)
+    return 0 if result.state.name == "SUCCEEDED" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Peer process for the netns two-host test (tests/test_netns_hosts.py).
+
+Runs INSIDE a network namespace via `ip netns exec`. Exercises the
+rendezvous store and the direct p2p data plane across a veth link that
+is the ONLY route between the two namespaces — the real multi-host
+shape: bind/advertise on a non-loopback interface address, dial the
+peer at the address it published, stream tensor frames both ways.
+
+argv: rank(0|1) store_host store_port my_ip peer_ip
+rank 0 hosts the store (native C++ epoll daemon when available).
+Prints "PEER_OK rank=N bytes=B" on success; any failure raises.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from pytorch_distributed_example_tpu.p2p import P2PPlane
+from pytorch_distributed_example_tpu.store import TCPStore
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    store_host = sys.argv[2]
+    store_port = int(sys.argv[3])
+    my_ip = sys.argv[4]
+
+    store = TCPStore(
+        host=store_host,
+        port=store_port,
+        is_master=(rank == 0),
+        world_size=2,
+        timeout=60.0,
+    )
+    plane = P2PPlane(rank, store, bind_host=my_ip, advertise=my_ip).start()
+
+    # store-level barrier: both peers present before planes dial
+    store.set(f"netns_ready_{rank}", b"1")
+    store.wait([f"netns_ready_{1 - rank}"], timeout=60.0)
+
+    small = np.arange(1 << 10, dtype=np.float32)
+    big = np.arange(1 << 21, dtype=np.float32)  # 8 MB: chunked framing
+    if rank == 0:
+        plane.send(1, "nt", 0, 0, small, 60.0)
+        plane.send(1, "nt", 0, 1, big, 60.0)
+        back = plane.recv(1, "nt", 0, 2, 60.0)
+        assert np.array_equal(back, big * 2.0), "echo mismatch"
+        # the bytes really crossed the veth: the outbound socket's peer
+        # is the OTHER namespace's interface address
+        peer_addr = plane._out[1].getpeername()[0]
+        assert peer_addr == sys.argv[5], (peer_addr, sys.argv[5])
+    else:
+        got_small = plane.recv(0, "nt", 0, 0, 60.0)
+        assert np.array_equal(got_small, small), "small frame mismatch"
+        got_big = plane.recv(0, "nt", 0, 1, 60.0)
+        assert np.array_equal(got_big, big), "big frame mismatch"
+        plane.send(0, "nt", 0, 2, got_big * 2.0, 60.0)
+
+    # hold until the peer confirms receipt so sockets aren't torn down
+    # under the last in-flight frame
+    store.set(f"netns_done_{rank}", b"1")
+    store.wait([f"netns_done_{1 - rank}"], timeout=60.0)
+    if rank == 1:
+        time.sleep(0.2)  # let rank 0's final recv drain before teardown
+    print(f"PEER_OK rank={rank} bytes={big.nbytes}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

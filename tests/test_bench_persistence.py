@@ -124,16 +124,33 @@ class TestCommonPersistResult:
 
 
 class TestWedgeWatchdogConfig:
-    def test_malformed_budget_disables(self, bench_mod, monkeypatch):
+    """Budget resolution only — _parse_budget is side-effect free, and
+    constructions pass start_thread=False so no _scan daemon (which can
+    os._exit the host process) ever runs inside pytest."""
+
+    def test_malformed_budget_falls_back_to_default(
+            self, bench_mod, monkeypatch):
+        # a typo must not silently disable the wedge breaker
         monkeypatch.setenv("BENCH_WEDGE_BUDGET", "240s")
-        w = bench_mod._WedgeWatchdog()
-        assert w.budget == 0.0
+        monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
+        w = bench_mod._WedgeWatchdog(start_thread=False)
+        assert w.budget == bench_mod._WedgeWatchdog.DEFAULT_BUDGET_S
 
     def test_default_on_at_900(self, bench_mod, monkeypatch):
         # the driver's end-of-round run must never wedge silently
         monkeypatch.delenv("BENCH_WEDGE_BUDGET", raising=False)
-        assert bench_mod._WedgeWatchdog().budget == 900.0
+        monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
+        w = bench_mod._WedgeWatchdog(start_thread=False)
+        assert w.budget == 900.0
 
     def test_zero_disables(self, bench_mod, monkeypatch):
         monkeypatch.setenv("BENCH_WEDGE_BUDGET", "0")
-        assert bench_mod._WedgeWatchdog().budget == 0.0
+        assert bench_mod._WedgeWatchdog(start_thread=False).budget == 0.0
+
+    def test_budget_clamps_above_probe_timeout(self, bench_mod,
+                                               monkeypatch):
+        # a long legitimate init probe must never trip the watchdog
+        monkeypatch.setenv("BENCH_WEDGE_BUDGET", "300")
+        monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1200")
+        w = bench_mod._WedgeWatchdog(start_thread=False)
+        assert w.budget == 1320.0

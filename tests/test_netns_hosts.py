@@ -1,0 +1,260 @@
+"""Two-host proof over REAL separate network stacks (round-4 verdict #6).
+
+The distinct-loopback tests (test_elastic.py, test_p2p_direct.py) prove
+the advertise/dial plumbing crosses address boundaries, but 127/8 still
+shares one network stack. Here each "host" is a Linux network namespace
+with its own interfaces, routing table and loopback, joined only by a
+veth pair — the closest a single machine gets to two hosts on a DCN:
+
+  nsA: veth 10.231.77.1/24   <-- only route -->   nsB: veth 10.231.77.2/24
+
+The rendezvous store daemon binds inside nsA; the nsB peer can reach it
+ONLY through the veth. Each p2p plane binds/advertises its namespace's
+interface address, so plane dialing, frame streaming (including an 8 MB
+chunked tensor) and the echo round-trip all traverse the link. Models
+gloo's cross-host full-mesh TCP (ProcessGroupGloo.hpp:48+) on real
+separate stacks.
+
+Requires CAP_NET_ADMIN (root); skipped where `ip netns` is unavailable.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IP_A, IP_B = "10.231.77.1", "10.231.77.2"
+
+
+def _ip(*args, check=True):
+    return subprocess.run(
+        ["ip", *args], capture_output=True, text=True, check=check,
+        timeout=30,
+    )
+
+
+def _netns_capable() -> bool:
+    try:
+        _ip("netns", "add", "tdx_capcheck")
+    except Exception:
+        return False
+    _ip("netns", "del", "tdx_capcheck", check=False)
+    return True
+
+
+@pytest.fixture()
+def ns_pair():
+    if not _netns_capable():
+        pytest.skip("ip netns unavailable (needs CAP_NET_ADMIN)")
+    pid = os.getpid()
+    nsa, nsb = f"tdx_a{pid}", f"tdx_b{pid}"
+    # pid-suffixed so concurrent runs can't collide on root-ns names
+    va, vb = f"vtdxa{pid % 10000}", f"vtdxb{pid % 10000}"
+    try:
+        _ip("netns", "add", nsa)
+        _ip("netns", "add", nsb)
+        _ip("link", "add", va, "type", "veth", "peer", "name", vb)
+        _ip("link", "set", va, "netns", nsa)
+        _ip("link", "set", vb, "netns", nsb)
+        for ns, dev, addr in ((nsa, va, IP_A), (nsb, vb, IP_B)):
+            _ip("-n", ns, "addr", "add", f"{addr}/24", "dev", dev)
+            _ip("-n", ns, "link", "set", dev, "up")
+            _ip("-n", ns, "link", "set", "lo", "up")
+        yield nsa, nsb
+    finally:
+        # deleting a ns deletes veth ends moved into it, but a setup
+        # failure can strand the pair in the root namespace
+        _ip("link", "del", va, check=False)
+        _ip("netns", "del", nsa, check=False)
+        _ip("netns", "del", nsb, check=False)
+
+
+def _spawn_peer(ns: str, rank: int, port: int, my_ip: str, peer_ip: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            "ip", "netns", "exec", ns, sys.executable,
+            os.path.join(ROOT, "tests", "_netns_peer.py"),
+            str(rank), IP_A, str(port), my_ip, peer_ip,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=ROOT,
+    )
+
+
+def test_store_and_plane_across_network_namespaces(ns_pair):
+    nsa, nsb = ns_pair
+    # no listener yet; any free port works — namespaces don't collide
+    port = 29441
+    p0 = _spawn_peer(nsa, 0, port, IP_A, IP_B)
+    p1 = _spawn_peer(nsb, 1, port, IP_B, IP_A)
+    try:
+        out0, err0 = p0.communicate(timeout=180)
+        out1, err1 = p1.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        p0.kill()
+        p1.kill()
+        raise
+    assert p0.returncode == 0, f"rank0 rc={p0.returncode}\n{err0[-2000:]}"
+    assert p1.returncode == 0, f"rank1 rc={p1.returncode}\n{err1[-2000:]}"
+    assert "PEER_OK rank=0" in out0
+    assert "PEER_OK rank=1" in out1
+
+
+LAN_IPS = ["10.231.78.1", "10.231.78.2", "10.231.78.3"]
+
+WORKER = """import os, time
+out = os.environ["OUT_DIR"]
+gen = os.environ["TDX_RESTART_COUNT"]
+world = os.environ["WORLD_SIZE"]
+rank = os.environ["RANK"]
+with open(os.path.join(out, f"run_g{gen}_w{world}_r{rank}"), "w") as f:
+    f.write(os.environ["GROUP_RANK"])
+while not os.path.exists(os.path.join(out, "STOP")):
+    time.sleep(0.02)
+"""
+
+
+@pytest.fixture()
+def ns_lan():
+    """Three namespaces on a root-namespace bridge — a model rack LAN
+    with any-to-any reachability, each 'host' a separate stack."""
+    if not _netns_capable():
+        pytest.skip("ip netns unavailable (needs CAP_NET_ADMIN)")
+    pid = os.getpid()
+    br = f"brtdx{pid % 10000}"
+    names = [f"tdx_l{i}_{pid}" for i in range(3)]
+    try:
+        _ip("link", "add", br, "type", "bridge")
+        _ip("link", "set", br, "up")
+        for i, ns in enumerate(names):
+            _ip("netns", "add", ns)
+            vr, vn = f"vtr{i}_{pid % 1000}", f"vtn{i}_{pid % 1000}"
+            _ip("link", "add", vr, "type", "veth", "peer", "name", vn)
+            _ip("link", "set", vn, "netns", ns)
+            _ip("link", "set", vr, "master", br)
+            _ip("link", "set", vr, "up")
+            _ip("-n", ns, "addr", "add", f"{LAN_IPS[i]}/24", "dev", vn)
+            _ip("-n", ns, "link", "set", vn, "up")
+            _ip("-n", ns, "link", "set", "lo", "up")
+        yield names
+    finally:
+        for ns in names:
+            _ip("netns", "del", ns, check=False)
+        for i in range(3):  # root-side ends stranded by a setup failure
+            _ip("link", "del", f"vtr{i}_{pid % 1000}", check=False)
+        _ip("link", "del", br, check=False)
+
+
+def _spawn_agent(ns, node_rank, nnodes, min_nnodes, port, out_dir,
+                 worker_py):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            "ip", "netns", "exec", ns, sys.executable,
+            os.path.join(ROOT, "tests", "_netns_agent.py"),
+            str(node_rank), str(nnodes), str(min_nnodes),
+            LAN_IPS[0], LAN_IPS[node_rank], str(port), out_dir, worker_py,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=ROOT,
+    )
+
+
+def _wait_files(paths, timeout, what):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(os.path.exists(p) for p in paths):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}: "
+                         f"{[p for p in paths if not os.path.exists(p)]}")
+
+
+def test_elastic_gang_and_store_failover_across_netns_lan(
+        ns_lan, tmp_path):
+    """The full P8 composition on real separate stacks: three elastic
+    agents — one per namespace — rendezvous at node 0's bridge address,
+    form a w=3 gang (gen 0), then node 0 (the STORE HOST) is SIGKILLed.
+    Survivors must detect the loss via heartbeats, promote the standby
+    store GOSSIPED from node 1's namespace address, and re-form at w=2
+    — every byte of rendezvous, heartbeat, gossip and re-formation
+    crossing the veth/bridge LAN."""
+    import json as json_mod
+    import signal
+    import time
+
+    worker_py = str(tmp_path / "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER)
+    out_dir = str(tmp_path)
+    port = 29447
+    procs = {
+        i: _spawn_agent(ns_lan[i], i, 3, 2, port, out_dir, worker_py)
+        for i in range(3)
+    }
+    try:
+        _wait_files(
+            [os.path.join(out_dir, f"run_g0_w3_r{r}") for r in range(3)],
+            timeout=90, what="gen0 w=3 gang across the LAN",
+        )
+        procs[0].send_signal(signal.SIGKILL)  # store-host loss
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if any(
+                os.path.exists(os.path.join(out_dir, f"run_g{g}_w2_r0"))
+                and os.path.exists(os.path.join(out_dir, f"run_g{g}_w2_r1"))
+                for g in range(1, 8)
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("survivors never re-formed at w=2")
+    finally:
+        with open(os.path.join(out_dir, "STOP"), "w") as f:
+            f.write("1")
+        outs = {}
+        for i, p in procs.items():
+            try:
+                outs[i] = p.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs[i] = p.communicate()
+    for i in (1, 2):
+        assert procs[i].returncode == 0, (
+            f"agent {i} rc={procs[i].returncode}\n{outs[i][1][-2000:]}"
+        )
+        rec = json_mod.loads(outs[i][0].strip().splitlines()[-1])
+        assert rec["state"] == "SUCCEEDED"
+        # the survivor moved off the dead namespace's store to the
+        # standby it learned from heartbeat gossip — node 1's address
+        assert rec["failovers"] >= 1, rec
+        assert rec["active_master"][0] == LAN_IPS[1], rec
+
+
+def test_namespaces_are_really_isolated(ns_pair):
+    """Control: without the veth route there is no path — nsB cannot
+    reach nsA's loopback, so anything the main test moved between the
+    peers necessarily crossed the veth."""
+    nsa, nsb = ns_pair
+    r = subprocess.run(
+        ["ip", "netns", "exec", nsb, sys.executable, "-c",
+         "import socket; socket.create_connection(('127.0.0.1', 1), 1)"],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert r.returncode != 0  # connection refused in nsB's own stack
+    # and nsA's interface address is NOT assigned in nsB
+    r2 = subprocess.run(
+        ["ip", "netns", "exec", nsb, sys.executable, "-c",
+         f"import socket; s=socket.socket(); s.bind(('{IP_A}', 0))"],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert r2.returncode != 0
