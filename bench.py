@@ -56,6 +56,54 @@ def _peak_flops(device_kind: str) -> float:
     return 0.0
 
 
+_CALIBRATION_CACHE = {}
+
+
+def _calibrated_peak(jax, dev):
+    """(peak_flops, meta): MFU denominator with a measured sanity floor.
+
+    The tunnel's devices can be faster silicon than their self-reported
+    `device_kind` (observed: a chip reporting "TPU v5 lite" sustaining
+    ~5x the v5e spec-sheet 197 TFLOP/s on a 4096^3 bf16 matmul).
+    Dividing achieved FLOP/s by the nominal spec would then report
+    MFU > 1. A large dependent-chain matmul is a LOWER bound on true
+    peak, so the denominator is max(nominal, measured); `meta` records
+    both so every MFU row is reconstructable. When the measured rate
+    wins, true peak is unknown-but-higher, so the reported MFU is an
+    upper bound on true MFU — flagged via peak_source.
+    """
+    kind = getattr(dev, "device_kind", "") or ""
+    if kind in _CALIBRATION_CACHE:
+        return _CALIBRATION_CACHE[kind]
+    nominal = _peak_flops(kind)
+    meta = {"peak_source": "spec_sheet", "nominal_peak_tflops": nominal / 1e12}
+    measured = 0.0
+    try:
+        import jax.numpy as jnp
+
+        n = 4096
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (n, n), jnp.bfloat16)
+        b = jax.random.normal(key, (n, n), jnp.bfloat16)
+        mm = jax.jit(lambda x, y: x @ y)
+        mm(a, b).block_until_ready()
+        reps = 10
+        t0 = time.perf_counter()
+        out = a
+        for _ in range(reps):
+            out = mm(out, b)
+        out.block_until_ready()
+        measured = 2 * n**3 * reps / (time.perf_counter() - t0)
+        meta["measured_matmul_tflops"] = round(measured / 1e12, 1)
+    except Exception as e:  # never let calibration sink the bench
+        meta["calibration_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    peak = max(nominal, measured)
+    if measured > nominal:
+        meta["peak_source"] = "calibrated_matmul_lower_bound"
+    _CALIBRATION_CACHE[kind] = (peak, meta)
+    return peak, meta
+
+
 def _probe_backend_subprocess(timeout_s: float):
     """Probe backend init in a KILLABLE subprocess.
 
@@ -356,10 +404,15 @@ def _bench_mfu(jax, is_tpu: bool):
     from pytorch_distributed_example_tpu.models import TransformerConfig, TransformerLM
 
     dev = jax.devices()[0]
-    peak = _peak_flops(getattr(dev, "device_kind", "") or "")
-    if not is_tpu or peak == 0.0:
-        # CPU fallback: no meaningful peak
+    if not is_tpu:
+        # CPU fallback: no meaningful peak (and no calibration matmul —
+        # 1.5 TFLOP of bf16 on a 1-core host takes minutes)
         return 0.0, 0.0, 0.0, {"flash_used": False, "flash_error": "cpu fallback"}
+    peak, peak_meta = _calibrated_peak(jax, dev)
+    if peak == 0.0:
+        return 0.0, 0.0, 0.0, {"flash_used": False,
+                               "flash_error": "unknown device peak",
+                               "peak_calibration": peak_meta}
 
     B = int(os.environ.get("BENCH_MFU_BATCH", "8"))
     L = int(os.environ.get("BENCH_MFU_SEQ", "512"))
@@ -446,6 +499,7 @@ def _bench_mfu(jax, is_tpu: bool):
 
     achieved = model_flops_per_step * steps / dt
     hfu = (hw_flops_per_step * steps / dt / peak) if hw_flops_per_step else 0.0
+    flash_info["peak_calibration"] = peak_meta
     if os.environ.get("BENCH_BREAKDOWN"):
         # where the non-MFU time goes (round-2 verdict #2): compare the
         # full train step against fwd-only and fwd+bwd programs on the

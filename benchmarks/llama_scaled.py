@@ -101,11 +101,13 @@ def run_mfu(args):
         )
         return
 
-    from bench import _peak_flops  # spec-sheet bf16 peaks
+    from bench import _calibrated_peak  # spec peaks + measured sanity floor
     from benchmarks.common import arm_wedge, wtick
 
     arm_wedge()  # honor BENCH_WEDGE_BUDGET: fail fast if the tunnel dies
-    peak = _peak_flops(kind)
+    # measured-matmul floor: the tunnel chip self-reports a kind slower
+    # than its real silicon; nominal spec alone would inflate MFU past 1
+    peak, peak_meta = _calibrated_peak(jax, dev)
     B, L = args.batch, args.seq
     # remat trades MFU for memory; ~1B bf16 states (~7.6 GB) may leave
     # room to skip it on a 16 GB chip — try --no-remat on hardware
@@ -163,6 +165,7 @@ def run_mfu(args):
         seq=L,
         remat=not args.no_remat,
         device_kind=kind,
+        peak_calibration=peak_meta,
     )
     from benchmarks.common import persist_result
 

@@ -221,6 +221,7 @@ def _ceiling_row(name, dev, cfg_kw, L, B, persist):
         evidence="aot_compile_only",
         device_kind=dev.device_kind,
         peak_bf16_flops=peak_flops,
+        peak_source="spec_sheet_nominal",
         hbm_bytes_per_s=hbm_bw,
         model_flops_per_step=model_flops,
         batch=B,
@@ -230,7 +231,11 @@ def _ceiling_row(name, dev, cfg_kw, L, B, persist):
         caveat=(
             "roofline upper bound from XLA cost analysis (flops + bytes "
             "accessed); real MFU sits below it — overlap, dispatch and "
-            "non-roofline ops are not modeled"
+            "non-roofline ops are not modeled. Peak here is the NOMINAL "
+            "spec for the self-reported device_kind; measured MFU rows "
+            "use bench._calibrated_peak (a measured-matmul floor), so on "
+            "silicon faster than its reported kind the two denominators "
+            "differ — compare via each row's recorded peak"
         ),
     )
     if persist:
