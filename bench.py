@@ -56,6 +56,23 @@ def _peak_flops(device_kind: str) -> float:
     return 0.0
 
 
+def _dsync(jax, x) -> float:
+    """Timing barrier that cannot lie — see benchmarks.common.device_sync.
+
+    block_until_ready is NOT trusted on this box: the axon tunnel's
+    readiness signal returns immediately while compile and execution are
+    still in flight (benchmarks/timing_audit.py measured a 113,556x
+    blocked-vs-readback divergence, which had produced physically
+    impossible rows like a 26 PFLOP/s train step). Every timed window in
+    this file ends with this barrier; the single implementation lives in
+    benchmarks/common.py so the two can't drift.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.common import device_sync
+
+    return device_sync(x)
+
+
 _CALIBRATION_CACHE = {}
 
 
@@ -85,14 +102,24 @@ def _calibrated_peak(jax, dev):
         key = jax.random.PRNGKey(0)
         a = jax.random.normal(key, (n, n), jnp.bfloat16)
         b = jax.random.normal(key, (n, n), jnp.bfloat16)
-        mm = jax.jit(lambda x, y: x @ y)
-        mm(a, b).block_until_ready()
+        # ONE jitted lax.scan program chaining `reps` dependent matmuls:
+        # separate dispatches cost ~8 ms each through the tunnel, which
+        # would swamp the sub-ms device matmul and make the measured
+        # floor useless (it could never exceed nominal). Normalizing
+        # each product keeps the bf16 chain finite.
         reps = 10
+
+        @jax.jit
+        def chain(x, y):
+            def body(c, _):
+                return (c @ y) / jnp.bfloat16(n), None
+
+            c, _ = jax.lax.scan(body, x, None, length=reps)
+            return c
+
+        _dsync(jax, chain(a, b))  # drain compile + first execution
         t0 = time.perf_counter()
-        out = a
-        for _ in range(reps):
-            out = mm(out, b)
-        out.block_until_ready()
+        _dsync(jax, chain(a, b))  # clock stops on real bytes
         measured = 2 * n**3 * reps / (time.perf_counter() - t0)
         meta["measured_matmul_tflops"] = round(measured / 1e12, 1)
     except Exception as e:  # never let calibration sink the bench
@@ -371,7 +398,7 @@ def _bench_ddp_mnist(jax, tdx):
         if sync_stride and (i + 1) % sync_stride == 0:
             jax.block_until_ready(loss)
             _tick("ddp_mnist_warmup")
-    jax.block_until_ready(loss)
+    _dsync(jax, loss)  # readback barrier (block_until_ready lies here)
     _tick("ddp_mnist_warmed")
 
     with _maybe_trace(jax):
@@ -381,11 +408,16 @@ def _bench_ddp_mnist(jax, tdx):
             if sync_stride and (i + 1) % sync_stride == 0:
                 jax.block_until_ready(loss)
                 _tick("ddp_mnist_timed")
-        jax.block_until_ready(loss)
+        final_loss = _dsync(jax, loss)
         dt = time.perf_counter() - t0
     _tick("ddp_mnist_done")
 
-    return steps * global_batch / dt / world, {"warmup": warmup, "steps": steps}
+    return steps * global_batch / dt / world, {
+        "warmup": warmup,
+        "steps": steps,
+        "final_loss": round(final_loss, 4),
+        "timing": "readback_barrier",
+    }
 
 
 def _bench_mfu(jax, is_tpu: bool):
@@ -458,6 +490,11 @@ def _bench_mfu(jax, is_tpu: bool):
     try:
         step, params, opt_state, toks, model = build(use_flash=True)
         params, opt_state, loss = step(params, opt_state, toks)  # compile probe
+        # barrier INSIDE the try: compile/exec failures surface async on
+        # this tunnel (block_until_ready returns before the error), so a
+        # lying barrier here would skip the dense fallback and sink the
+        # whole bench at the first timed readback instead
+        _dsync(jax, loss)
     except Exception as e:
         flash_info = {
             "flash_used": False,
@@ -466,7 +503,7 @@ def _bench_mfu(jax, is_tpu: bool):
         _tick("mfu_flash_failed")
         step, params, opt_state, toks, model = build(use_flash=False)
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+        _dsync(jax, loss)
     _tick("mfu_compiled")
 
     # Analytic model FLOPs per step: fwd 2 x (6N+12*l*d*L is already the
@@ -488,18 +525,20 @@ def _bench_mfu(jax, is_tpu: bool):
 
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    _dsync(jax, loss)  # readback barrier (block_until_ready lies here)
     _tick("mfu_warmed")
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    final_loss = _dsync(jax, loss)
     dt = time.perf_counter() - t0
     _tick("mfu_timed")
 
     achieved = model_flops_per_step * steps / dt
     hfu = (hw_flops_per_step * steps / dt / peak) if hw_flops_per_step else 0.0
     flash_info["peak_calibration"] = peak_meta
+    flash_info["mfu_final_loss"] = round(final_loss, 4)
+    flash_info["timing"] = "readback_barrier"
     if os.environ.get("BENCH_BREAKDOWN"):
         # where the non-MFU time goes (round-2 verdict #2): compare the
         # full train step against fwd-only and fwd+bwd programs on the
@@ -539,11 +578,11 @@ def _mfu_breakdown(jax, model, params, toks, steps, step_s):
     out = {"full_step": round(step_s * 1e3, 3)}
     for name, fn in (("fwd", fwd), ("fwd_bwd", fwd_bwd)):
         r = fn(params, toks)  # compile
-        jax.block_until_ready(r)
+        _dsync(jax, r)
         t0 = time.perf_counter()
         for _ in range(steps):
             r = fn(params, toks)
-        jax.block_until_ready(r)
+        _dsync(jax, r)
         out[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
     return out
 

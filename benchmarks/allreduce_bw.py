@@ -48,7 +48,7 @@ def main():
 
     import pytorch_distributed_example_tpu as tdx
 
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
 
     if not tdx.is_initialized():
         tdx.init_process_group(backend="xla")
@@ -115,11 +115,11 @@ def main():
                 out = run()
             if out is None:  # --warmup 0: still need one compile pass
                 out = run()
-            out.block_until_ready()
+            device_sync(out)  # readback barrier: block_until_ready lies
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 out = run()
-            out.block_until_ready()
+            device_sync(out)
             dt = (time.perf_counter() - t0) / args.iters
             payload = (
                 size

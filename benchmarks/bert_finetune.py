@@ -39,7 +39,7 @@ def main():
     import optax
 
     import pytorch_distributed_example_tpu as tdx
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
     from pytorch_distributed_example_tpu.models import (
         BertConfig,
         BertForSequenceClassification,
@@ -75,14 +75,14 @@ def main():
     p = ddp.params
     for i in range(args.warmup):
         p, opt_state, loss = step(p, opt_state, x, y, jax.random.PRNGKey(i))
-    jax.block_until_ready(loss)
+    device_sync(loss)  # readback barrier: block_until_ready lies here
 
     t0 = time.perf_counter()
     for i in range(args.steps):
         p, opt_state, loss = step(
             p, opt_state, x, y, jax.random.PRNGKey(args.warmup + i)
         )
-    jax.block_until_ready(loss)
+    device_sync(loss)
     dt = time.perf_counter() - t0
 
     per_chip = args.steps * gb / dt / W

@@ -113,3 +113,62 @@ def on_tpu() -> bool:
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", "") or ""
     return d.platform.lower() in ("tpu", "axon") or "tpu" in kind.lower()
+
+
+_SYNC_COMBINE = None
+
+
+def device_sync(x) -> float:
+    """Queue barrier that cannot lie: fetch one element of `x` to host.
+
+    `block_until_ready` is NOT trusted for timing on this box: the axon
+    tunnel's readiness signal returns immediately while compile AND
+    execution are still in flight (round-5 `timing_audit`: 0.3 ms
+    "blocked" vs 39.7 s to actually materialize the same bytes — a
+    113,556x divergence that produced physically impossible rows like a
+    26 PFLOP/s 1B-model train step). A device->host copy of real bytes
+    must wait for every queued dependency, so timing windows bracketed
+    by `device_sync` measure execution, not dispatch. Errors from async
+    work (e.g. OOM) also surface here instead of being lost.
+
+    Returns the fetched element so callers can assert finiteness. For a
+    multi-leaf pytree (e.g. a whole params tree), a single combining
+    program that reads one element of EVERY leaf is dispatched and its
+    scalar fetched — one barrier that depends on all leaves, instead of
+    per-leaf round trips over the ~8 ms/dispatch tunnel.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    global _SYNC_COMBINE
+    leaves = jax.tree_util.tree_leaves(x)
+    if len(leaves) == 1:
+        first = leaves[0]
+        if hasattr(first, "ndim") and first.ndim > 0:
+            first = first.ravel()[:1]
+        return float(np.asarray(jax.device_get(first)).ravel()[0])
+
+    if _SYNC_COMBINE is None:
+        def _combine(ls):
+            tot = jnp.float32(0)
+            for leaf in jax.tree_util.tree_leaves(ls):
+                tot = tot + leaf.reshape(-1)[0].astype(jnp.float32)
+            return tot
+
+        # one module-level jit: cached by (treedef, shapes, dtypes), so
+        # repeat barriers over the same tree recompile nothing
+        _SYNC_COMBINE = jax.jit(_combine)
+    return float(np.asarray(jax.device_get(_SYNC_COMBINE(leaves))))
+
+
+def measure_rtt(x, reps: int = 3) -> float:
+    """Median seconds of a `device_sync` on already-materialized data —
+    the fixed per-barrier cost to subtract from short timed windows."""
+    device_sync(x)  # drain any queued work first
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        device_sync(x)
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]

@@ -40,7 +40,7 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
     from pytorch_distributed_example_tpu.models import (
         TransformerConfig,
         TransformerLM,
@@ -65,20 +65,20 @@ def main():
 
     # warmup: compiles prefill + decode body (both call shapes)
     out = generate(model, params, prompt, args.new, rng=jax.random.PRNGKey(1))
-    jax.block_until_ready(out)
+    device_sync(out)  # readback barrier: block_until_ready lies here
     out = generate(model, params, prompt, 1, rng=jax.random.PRNGKey(1))
-    jax.block_until_ready(out)
+    device_sync(out)
 
     # steady-state decode = full call minus a prefill-only call, so the
     # reported tokens/s is decode-only as the metric name promises
     t0 = time.perf_counter()
     out = generate(model, params, prompt, 1, rng=jax.random.PRNGKey(2))
-    jax.block_until_ready(out)
+    device_sync(out)
     dt_prefill = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     out = generate(model, params, prompt, args.new, rng=jax.random.PRNGKey(2))
-    jax.block_until_ready(out)
+    device_sync(out)
     dt_full = time.perf_counter() - t0
     dt = max(dt_full - dt_prefill, 1e-9)
 

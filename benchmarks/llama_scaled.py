@@ -136,18 +136,20 @@ def run_mfu(args):
         updates, opt_state2 = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state2, loss
 
+    from benchmarks.common import device_sync
+
     wtick("mfu_init_done")
     params, opt_state, loss = step(params, opt_state, toks)  # compile
-    jax.block_until_ready(loss)
+    device_sync(loss)  # readback barrier: block_until_ready lies here
     wtick("mfu_compiled")
     for _ in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    device_sync(loss)
     wtick("mfu_warmed")
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, loss = step(params, opt_state, toks)
-    jax.block_until_ready(loss)
+    final_loss = device_sync(loss)
     wtick("mfu_timed")
     dt = (time.perf_counter() - t0) / args.steps
 
@@ -166,6 +168,8 @@ def run_mfu(args):
         remat=not args.no_remat,
         device_kind=kind,
         peak_calibration=peak_meta,
+        final_loss=round(final_loss, 4),
+        timing="readback_barrier",
     )
     from benchmarks.common import persist_result
 

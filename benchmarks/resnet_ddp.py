@@ -34,7 +34,7 @@ def main():
         ResNet18,
         convert_sync_batchnorm,
     )
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
 
     if not tdx.is_initialized():
         tdx.init_process_group(backend="xla")
@@ -93,12 +93,12 @@ def main():
     opt_state = opt.init(params)
     for _ in range(args.warmup):
         params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    device_sync(loss)  # readback barrier: block_until_ready lies here
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    device_sync(loss)
     dt = time.perf_counter() - t0
 
     per_chip = args.steps * gb / dt / W

@@ -1,0 +1,175 @@
+"""Timing-methodology audit: does block_until_ready tell the truth here?
+
+Round-5 trigger: `llama_scaled --mode mfu --no-remat` measured a 1.9 ms
+train step for a 940M-param model (26 PFLOP/s on one chip) — physically
+impossible and 27x the same session's measured pure-matmul rate, which a
+matmul-dominated step cannot exceed. Either the tunnel's readiness
+signal lies (timing captures dispatch, not execution) or something
+collapsed the computation.
+
+The audit separates the hypotheses with device-to-host VALUE READBACK,
+which cannot lie — the bytes must exist on the host:
+
+  phase A  matmul chain, block_until_ready timing vs +readback timing
+  phase B  the exact llama-1B no-remat train step: per-step wall time
+           with block_until_ready only, then with a float(loss) readback
+           every step, and loss values printed (finite + decreasing
+           confirms real execution)
+
+If blocked-vs-readback agree (within an RTT), readiness is truthful and
+the fast numbers demand a different explanation; if they diverge wildly,
+every *_short timing row measured dispatch and must be re-keyed.
+
+Run on TPU only. Writes benchmarks/results.json row `timing_audit`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu" and os.environ.get("AUDIT_ALLOW_CPU") != "1":
+        print(json.dumps({"error": "tpu only"}))
+        return 2
+    out = {
+        "metric": "timing_audit",
+        "value": 0.0,
+        "unit": "blocked_vs_readback_ratio",
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+    # --- phase A: matmul chain --------------------------------------
+    n = int(os.environ.get("AUDIT_MM_N", "4096"))
+    reps = int(os.environ.get("AUDIT_MM_REPS", "10"))
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    # scale to keep the chain finite: normalize each product
+    mm = jax.jit(lambda x, y: (x @ y) / jnp.bfloat16(n))
+    mm(a, b).block_until_ready()
+
+    t0 = time.perf_counter()
+    outv = a
+    for _ in range(reps):
+        outv = mm(outv, b)
+    outv.block_until_ready()
+    t_blocked = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    outv = a
+    for _ in range(reps):
+        outv = mm(outv, b)
+    corner = float(np.asarray(outv[:1, :1]))  # bytes must cross the wire
+    t_readback = time.perf_counter() - t0
+    out["mm"] = {
+        "n": n,
+        "reps": reps,
+        "blocked_s": round(t_blocked, 4),
+        "readback_s": round(t_readback, 4),
+        "ratio": round(t_readback / max(t_blocked, 1e-9), 2),
+        "tflops_blocked": round(2 * n**3 * reps / t_blocked / 1e12, 1),
+        "tflops_readback": round(2 * n**3 * reps / t_readback / 1e12, 1),
+        "corner_value": corner,
+    }
+    print(json.dumps({"phase": "mm", **out["mm"]}), flush=True)
+
+    # --- phase B: the exact 1B no-remat train step -------------------
+    if os.environ.get("AUDIT_SKIP_LLAMA") != "1":
+        import optax
+
+        from benchmarks.llama_scaled import CFG_1B, _build, _n_params, _analytic_flops
+
+        B = 8
+        L = 1024
+        model, cfg = _build(CFG_1B, L, True, use_flash=True, remat=False)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (B, L)),
+            jnp.int32,
+        )
+        params = model.init(jax.random.PRNGKey(0), toks)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+        n_params = _n_params(params)
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, toks):
+            def lf(p):
+                logits = model.apply(p, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1].astype(jnp.float32), toks[:, 1:]
+                ).mean()
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        params, opt_state, loss = step(params, opt_state, toks)
+        jax.block_until_ready(loss)
+
+        steps = int(os.environ.get("AUDIT_LLAMA_STEPS", "10"))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+        jax.block_until_ready(loss)
+        t_blocked = time.perf_counter() - t0
+
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+            losses.append(float(loss))  # host readback EVERY step
+        t_readback = time.perf_counter() - t0
+        flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)
+        out["llama_1b_noremat"] = {
+            "steps": steps,
+            "blocked_s": round(t_blocked, 4),
+            "readback_s": round(t_readback, 4),
+            "ratio": round(t_readback / max(t_blocked, 1e-9), 2),
+            "step_ms_blocked": round(t_blocked / steps * 1e3, 2),
+            "step_ms_readback": round(t_readback / steps * 1e3, 2),
+            "tflops_blocked": round(flops * steps / t_blocked / 1e12, 1),
+            "tflops_readback": round(flops * steps / t_readback / 1e12, 1),
+            "losses_first_last": [round(losses[0], 4), round(losses[-1], 4)],
+            "losses_finite": all(np.isfinite(losses)),
+        }
+        print(json.dumps({"phase": "llama", **out["llama_1b_noremat"]}),
+              flush=True)
+
+        out["value"] = out["llama_1b_noremat"]["ratio"]
+
+    verdict = (
+        "readiness_truthful"
+        if all(
+            p.get("ratio", 1.0) < 3.0
+            for p in (out.get("mm", {}), out.get("llama_1b_noremat", {}))
+        )
+        else "blocked_timing_understates_execution"
+    )
+    out["verdict"] = verdict
+    print(json.dumps(out), flush=True)
+
+    from benchmarks.common import persist_result
+
+    persist_result("timing_audit", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

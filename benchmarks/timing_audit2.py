@@ -1,0 +1,159 @@
+"""Truthful-timing audit v2: true execution rates via readback barriers.
+
+Audit v1 proved the tunnel's block_until_ready lies (113,556x); its
+readback numbers were still confounded — the timed window inherited the
+backlog of earlier un-synced dispatches (including jit COMPILE, which
+the lying readiness also hides). v2 drains the queue with
+common.device_sync before every window:
+
+  rtt         per-barrier cost on materialized data
+  mm_single   one 4096^3 bf16 matmul, barrier-bracketed
+  mm_chain    10 dependent matmuls, one barrier at the end
+  llama_step  1B-param remat train step (B=8, L=1024), 5 steps
+
+Writes row `timing_audit_true` with TFLOP/s per phase. TPU only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.common import device_sync, measure_rtt, persist_result
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu" and os.environ.get("AUDIT_ALLOW_CPU") != "1":
+        print(json.dumps({"error": "tpu only"}))
+        return 2
+    out = {
+        "metric": "timing_audit_true",
+        "value": 0.0,
+        "unit": "bf16_matmul_tflops_true",
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+    tiny = jnp.zeros((), jnp.float32) + 1
+    rtt = measure_rtt(tiny)
+    out["rtt_s"] = round(rtt, 4)
+    print(json.dumps({"phase": "rtt", "rtt_s": out["rtt_s"]}), flush=True)
+
+    n = int(os.environ.get("AUDIT_MM_N", "4096"))
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    mm = jax.jit(lambda x, y: (x @ y) / jnp.bfloat16(n))
+
+    t0 = time.perf_counter()
+    device_sync(mm(a, b))  # includes compile
+    compile_and_first = time.perf_counter() - t0
+    out["mm_compile_plus_first_s"] = round(compile_and_first, 2)
+
+    t0 = time.perf_counter()
+    v = device_sync(mm(a, b))
+    single = max(time.perf_counter() - t0 - rtt, 1e-9)
+    out["mm_single"] = {
+        "seconds": round(single, 4),
+        "tflops": round(2 * n**3 / single / 1e12, 1),
+        "value": v,
+    }
+    print(json.dumps({"phase": "mm_single", **out["mm_single"]}), flush=True)
+
+    reps = int(os.environ.get("AUDIT_MM_REPS", "10"))
+    outv = a
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outv = mm(outv, b)
+    v = device_sync(outv)
+    chain = max(time.perf_counter() - t0 - rtt, 1e-9)
+    out["mm_chain"] = {
+        "reps": reps,
+        "seconds": round(chain, 4),
+        "tflops": round(2 * n**3 * reps / chain / 1e12, 1),
+        "value": v,
+    }
+    out["value"] = out["mm_chain"]["tflops"]
+    print(json.dumps({"phase": "mm_chain", **out["mm_chain"]}), flush=True)
+    del outv, a, b
+
+    if os.environ.get("AUDIT_SKIP_LLAMA") != "1":
+        import optax
+
+        from benchmarks.llama_scaled import (
+            CFG_1B,
+            _analytic_flops,
+            _build,
+            _n_params,
+        )
+
+        B, L = 8, 1024
+        model, cfg = _build(CFG_1B, L, True, use_flash=True, remat=True)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (B, L)),
+            jnp.int32,
+        )
+        params = model.init(jax.random.PRNGKey(0), toks)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+        device_sync(params)  # materialize before timing anything
+        n_params = _n_params(params)
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, toks):
+            def lf(p):
+                logits = model.apply(p, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1].astype(jnp.float32), toks[:, 1:]
+                ).mean()
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, toks)
+        l0 = device_sync(loss)
+        out["llama_compile_plus_first_s"] = round(time.perf_counter() - t0, 2)
+
+        steps = int(os.environ.get("AUDIT_LLAMA_STEPS", "5"))
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(device_sync(loss))
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)
+        out["llama_1b_remat"] = {
+            "steps": steps,
+            "seconds": round(dt, 3),
+            "step_ms": round(dt / steps * 1e3, 1),
+            "tflops": round(flops * steps / dt / 1e12, 1),
+            "loss_first": round(l0, 4),
+            "loss_last": round(losses[-1], 4),
+            "loss_finite": bool(np.isfinite(losses[-1])),
+        }
+        print(json.dumps({"phase": "llama", **out["llama_1b_remat"]}),
+              flush=True)
+
+    print(json.dumps(out), flush=True)
+    persist_result("timing_audit_true", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,7 +51,7 @@ def main():
     import optax
 
     import pytorch_distributed_example_tpu as tdx
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
     from pytorch_distributed_example_tpu.models import ConvNet
 
     if not tdx.is_initialized():
@@ -73,7 +73,7 @@ def main():
 
     p = ddp.params
     p, opt_state, loss = step(p, opt_state, x, y)  # compile outside trace
-    jax.block_until_ready(loss)
+    device_sync(loss)  # readback barrier: block_until_ready lies here
 
     run_dir = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -83,7 +83,7 @@ def main():
     with jax.profiler.trace(run_dir):
         for _ in range(args.steps):
             p, opt_state, loss = step(p, opt_state, x, y)
-        jax.block_until_ready(loss)
+        device_sync(loss)  # ensure the traced steps really executed
 
     planes = glob.glob(
         os.path.join(run_dir, "**", "*.xplane.pb"), recursive=True

@@ -57,7 +57,7 @@ def main():
         transformer_sharding_rules,
     )
     from pytorch_distributed_example_tpu.parallel import fully_shard
-    from benchmarks.common import emit
+    from benchmarks.common import device_sync, emit
 
     n_dev = len(jax.devices())
     tp = args.tp
@@ -98,11 +98,11 @@ def main():
     p, s = mod.params, opt_state
     for _ in range(args.warmup):
         p, s, loss = step(p, s, toks, toks)
-    jax.block_until_ready(loss)
+    device_sync(loss)  # readback barrier: block_until_ready lies here
     t0 = time.perf_counter()
     for _ in range(args.steps):
         p, s, loss = step(p, s, toks, toks)
-    jax.block_until_ready(loss)
+    device_sync(loss)
     dt = time.perf_counter() - t0
 
     tokens = args.steps * args.batch * args.seq
