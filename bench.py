@@ -347,6 +347,17 @@ def _bench_ddp_mnist(jax, tdx):
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "64"))
     warmup = int(os.environ.get("BENCH_WARMUP", "20"))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
+    # BENCH_SCAN_STEPS=K>1: the framework's steps_per_call path — K full
+    # optimizer steps (each with its own reduction and update) fused into
+    # one compiled program via lax.scan. Same math as the sequential
+    # schedule (tests/test_ddp.py pins it); host dispatch is paid once
+    # per K steps, which on a ~ms-per-dispatch remote tunnel is the
+    # difference between dispatch-bound and device-bound training for a
+    # model this small. Reported in meta as steps_per_dispatch.
+    scan_k = int(os.environ.get("BENCH_SCAN_STEPS", "1"))
+    if scan_k > 1:
+        steps = (steps // scan_k) * scan_k or scan_k
+        warmup = max(warmup // scan_k, 1) * scan_k
 
     world = tdx.get_world_size()
     global_batch = batch_per_chip * world
@@ -360,7 +371,10 @@ def _bench_ddp_mnist(jax, tdx):
     def loss_fn(logits, y):
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
-    step = ddp.make_train_step(opt, loss_fn, has_rng=True)
+    step = ddp.make_train_step(
+        opt, loss_fn, has_rng=True,
+        **({"steps_per_call": scan_k} if scan_k > 1 else {}),
+    )
     opt_state = opt.init(ddp.params)
 
     gen = np.random.default_rng(0)
@@ -391,6 +405,47 @@ def _bench_ddp_mnist(jax, tdx):
         if jax.devices()[0].platform == "cpu" and world > 1
         else 0
     )
+
+    if scan_k > 1:
+        data_sh_k = NamedSharding(step.mesh, P(None, step.axis))
+        xs = jax.device_put(
+            jnp.broadcast_to(x, (scan_k,) + x.shape), data_sh_k
+        )
+        ys = jax.device_put(
+            jnp.broadcast_to(y, (scan_k,) + y.shape), data_sh_k
+        )
+        # pre-slice key chunks OUTSIDE the timed loop (same invariant as
+        # the per-step path: the loop body must be exactly one dispatch)
+        key_chunks = [
+            all_keys[i : i + scan_k]
+            for i in range(0, warmup + steps, scan_k)
+        ]
+        n_warm = warmup // scan_k
+
+        p = ddp.params
+        for ch in key_chunks[:n_warm]:
+            p, opt_state, losses = step(p, opt_state, xs, ys, ch)
+            if sync_stride:  # same XLA:CPU rendezvous guard as below
+                jax.block_until_ready(losses)
+        _dsync(jax, losses)
+        _tick("ddp_mnist_warmed")
+        with _maybe_trace(jax):
+            t0 = time.perf_counter()
+            for ch in key_chunks[n_warm:]:
+                p, opt_state, losses = step(p, opt_state, xs, ys, ch)
+                if sync_stride:
+                    jax.block_until_ready(losses)
+                    _tick("ddp_mnist_timed")
+            final_loss = _dsync(jax, losses[-1])
+            dt = time.perf_counter() - t0
+        _tick("ddp_mnist_done")
+        return steps * global_batch / dt / world, {
+            "warmup": warmup,
+            "steps": steps,
+            "steps_per_dispatch": scan_k,
+            "final_loss": round(final_loss, 4),
+            "timing": "readback_barrier",
+        }
 
     p = ddp.params
     for i in range(warmup):
@@ -523,12 +578,38 @@ def _bench_mfu(jax, is_tpu: bool):
     except Exception:
         pass
 
+    # BENCH_MFU_SCAN=K>1: K full optimizer steps per dispatch via
+    # lax.scan (identical math; host dispatch amortized K-fold). The toy
+    # config's per-step device time is ~ms-scale, so per-step dispatch
+    # over the tunnel dominates without this.
+    scan_k = int(os.environ.get("BENCH_MFU_SCAN", "1"))
+    if scan_k > 1:
+        steps = max(steps // scan_k, 1) * scan_k
+        warmup = max(warmup // scan_k, 1)
+        base_step = step
+
+        @jax.jit
+        def step(params, opt_state, toks):  # noqa: F811 — same signature
+            def body(c, _):
+                p, o, _l = base_step(c[0], c[1], toks)
+                return (p, o), _l
+
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=scan_k
+            )
+            return p, o, losses[-1]
+
+        params, opt_state, loss = step(params, opt_state, toks)
+        _dsync(jax, loss)  # compile the scanned program outside the clock
+        flash_info["steps_per_dispatch"] = scan_k
+    dispatches = steps // scan_k if scan_k > 1 else steps
+
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, toks)
     _dsync(jax, loss)  # readback barrier (block_until_ready lies here)
     _tick("mfu_warmed")
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(dispatches):
         params, opt_state, loss = step(params, opt_state, toks)
     final_loss = _dsync(jax, loss)
     dt = time.perf_counter() - t0
@@ -816,8 +897,7 @@ def main():
             "value": round(per_chip, 1),
             "unit": "samples/s/chip",
             "world": tdx.get_world_size(),
-            "warmup": run_meta["warmup"],
-            "steps": run_meta["steps"],
+            **run_meta,
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "platform": platform,
             "device_kind": device_kind,

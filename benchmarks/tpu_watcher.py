@@ -76,20 +76,43 @@ BATTERY = [
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
     ),
     (
-        # the AOT roofline says no-remat is compute-bound with headroom
-        # and fits 15.3 GB < 16 GB — likely the best single-chip MFU
-        # configuration, so it runs before the remat variant
-        "llama_mfu_1b_noremat",
-        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
-         "--no-remat"],
-        {"TDX_MFU_KEY_SUFFIX": "_noremat", "BENCH_WEDGE_BUDGET": "1200"},
-        2400,
-        ["benchmarks/results.json"],
+        # headline again with K=8 fused optimizer steps per dispatch
+        # (DDP steps_per_call): the ConvNet's device time is tiny, so
+        # per-step tunnel dispatch dominates the plain headline; this
+        # measures the framework's dispatch-amortized deployment mode
+        "headline_scan8",
+        [sys.executable, "bench.py"],
+        {
+            "BENCH_WINDOW_S": "0",
+            "BENCH_INIT_TRIES": "1",
+            "BENCH_PROBE_TIMEOUT": "60",
+            "BENCH_SCAN_STEPS": "8",
+            "BENCH_MFU_SCAN": "8",
+            "BENCH_HEADLINE_KEY": "headline_scan8",
+            "BENCH_WEDGE_BUDGET": "420",
+        },
+        1200,
+        ["benchmarks/results.json", "BENCH_WATCHER.json"],
     ),
+    # NOTE: the --no-remat 1B variant is gone from the battery: with
+    # truthful readback barriers it RESOURCE_EXHAUSTEDs on the real chip
+    # (the AOT 15.3 GB estimate does not leave room for runtime overhead
+    # on 16 GB) — its earlier "96 s ok" was dispatch-timing fiction.
     (
         "llama_mfu_1b",
         [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"],
         {"BENCH_WEDGE_BUDGET": "1200"},
+        2400,
+        ["benchmarks/results.json"],
+    ),
+    (
+        # larger per-step batch amortizes weight HBM traffic over 2x the
+        # tokens — the likely best single-chip MFU configuration now that
+        # no-remat is out
+        "llama_mfu_1b_b16",
+        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
+         "--batch", "16"],
+        {"TDX_MFU_KEY_SUFFIX": "_b16", "BENCH_WEDGE_BUDGET": "1200"},
         2400,
         ["benchmarks/results.json"],
     ),

@@ -190,6 +190,72 @@ class TestCommHooks:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+    def test_steps_per_call_matches_sequential(self, convnet_setup, world):
+        """steps_per_call=3 (K fused optimizer steps, one program) is
+        numerically identical to 3 sequential single-step calls with the
+        same per-step batches and rng keys."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+
+        model, params = convnet_setup
+        K = 3
+        ds = SyntheticMNIST(512)
+        xs_np, ys_np = ds[np.arange(K * 64)]
+        xs = jnp.asarray(xs_np).reshape((K, 64) + xs_np.shape[1:])
+        ys = jnp.asarray(ys_np).reshape((K, 64))
+        keys = jax.random.split(jax.random.PRNGKey(7), K)
+        loss_fn = _loss_fn()
+        opt = optax.sgd(0.1)
+
+        ddp = tdx.DistributedDataParallel(model, params)
+        step1 = ddp.make_train_step(opt, loss_fn, has_rng=True)
+        p, s = ddp.params, opt.init(ddp.params)
+        seq_losses = []
+        for i in range(K):
+            p, s, loss = step1(p, s, xs[i], ys[i], keys[i])
+            seq_losses.append(float(loss))
+
+        ddp2 = tdx.DistributedDataParallel(model, params)
+        stepK = ddp2.make_train_step(
+            opt, loss_fn, has_rng=True, steps_per_call=K
+        )
+        pk, sk, losses = stepK(ddp2.params, opt.init(ddp2.params), xs, ys, keys)
+
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(seq_losses), rtol=1e-5, atol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(pk)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
+    def test_steps_per_call_no_rng(self, convnet_setup, world):
+        """The has_rng=False path stacks dummy keys internally."""
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+
+        model, params = convnet_setup
+        K = 2
+        ds = SyntheticMNIST(256)
+        xs_np, ys_np = ds[np.arange(K * 64)]
+        xs = jnp.asarray(xs_np).reshape((K, 64) + xs_np.shape[1:])
+        ys = jnp.asarray(ys_np).reshape((K, 64))
+        opt = optax.sgd(0.1)
+
+        ddp = tdx.DistributedDataParallel(model, params)
+        stepK = ddp.make_train_step(opt, _loss_fn(), steps_per_call=K)
+        _, _, losses = stepK(ddp.params, opt.init(ddp.params), xs, ys)
+        assert losses.shape == (K,)
+        assert np.isfinite(np.asarray(losses)).all()
+
+
 class TestFakeBackend:
     def test_fake_group_identity_allreduce(self, world):
         g = tdx.new_group(backend="fake")
