@@ -142,7 +142,12 @@ def device_sync(x) -> float:
     import numpy as np
 
     global _SYNC_COMBINE
-    leaves = jax.tree_util.tree_leaves(x)
+    # unwrap framework DistTensors (not registered as pytrees) to their
+    # backing jax arrays, at the root and at leaf positions
+    x = getattr(x, "array", x)
+    leaves = [
+        getattr(l, "array", l) for l in jax.tree_util.tree_leaves(x)
+    ]
     if len(leaves) == 1:
         first = leaves[0]
         if hasattr(first, "ndim") and first.ndim > 0:
