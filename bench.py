@@ -689,10 +689,16 @@ def _committed_tpu_rows():
         r = entry.get("result") if isinstance(entry, dict) else None
         if not isinstance(r, dict):
             continue
-        if str(r.get("platform", "")).lower() not in ("tpu", "axon"):
+        plat = str(r.get("platform", "")).lower()
+        kind = str(r.get("device_kind", "")).lower()
+        # some benches record only device_kind (e.g. llama_scaled's mfu
+        # rows); either field identifies TPU evidence
+        if plat not in ("tpu", "axon") and "tpu" not in kind:
             continue
         if r.get("error"):
             continue  # a wedge-dump row is not evidence
+        if r.get("timing_invalid"):
+            continue  # dispatch-timed row kept only for the audit trail
         rows[key] = {
             k: r[k]
             for k in ("metric", "value", "unit", "mfu", "measured_at",

@@ -166,6 +166,7 @@ def run_mfu(args):
         batch=B,
         seq=L,
         remat=not args.no_remat,
+        platform=dev.platform,
         device_kind=kind,
         peak_calibration=peak_meta,
         final_loss=round(final_loss, 4),
