@@ -94,10 +94,19 @@ BATTERY = [
         1200,
         ["benchmarks/results.json", "BENCH_WATCHER.json"],
     ),
-    # NOTE: the --no-remat 1B variant is gone from the battery: with
-    # truthful readback barriers it RESOURCE_EXHAUSTEDs on the real chip
-    # (the AOT 15.3 GB estimate does not leave room for runtime overhead
-    # on 16 GB) — its earlier "96 s ok" was dispatch-timing fiction.
+    # NOTE: --no-remat at the default batch 8 RESOURCE_EXHAUSTEDs on the
+    # real chip (the AOT 15.3 GB estimate leaves no room for runtime
+    # overhead on 16 GB; its earlier "96 s ok" was dispatch-timing
+    # fiction). At batch 4 it fits and skips all recompute — the best
+    # measured single-chip MFU config (0.741 vs remat-b8's 0.595).
+    (
+        "llama_mfu_1b_noremat_b4",
+        [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu",
+         "--no-remat", "--batch", "4"],
+        {"TDX_MFU_KEY_SUFFIX": "_noremat_b4", "BENCH_WEDGE_BUDGET": "1200"},
+        2400,
+        ["benchmarks/results.json"],
+    ),
     (
         "llama_mfu_1b",
         [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"],
