@@ -22,7 +22,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import contextlib
 import os
 import sys
 import time
@@ -147,17 +146,11 @@ def run_mfu(args):
         params, opt_state, loss = step(params, opt_state, toks)
     device_sync(loss)
     wtick("mfu_warmed")
-    # TDX_TRACE_DIR: capture the timed steps under jax.profiler so the
-    # flash custom-calls and the fused train step land on a committed
-    # timeline (the 1B-model analog of bench.py's BENCH_TRACE)
-    trace_dir = os.environ.get("TDX_TRACE_DIR")
-    ctx = (
-        jax.profiler.trace(os.path.join(trace_dir,
-                                        time.strftime("%Y%m%dT%H%M%S")))
-        if trace_dir
-        else contextlib.nullcontext()
-    )
-    with ctx:
+    # BENCH_TRACE=<dir>: same knob and wrapper as bench.py — the timed
+    # steps land on a jax.profiler timeline (flash custom-calls visible)
+    from bench import _maybe_trace
+
+    with _maybe_trace(jax):
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, opt_state, loss = step(params, opt_state, toks)

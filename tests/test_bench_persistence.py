@@ -154,3 +154,62 @@ class TestWedgeWatchdogConfig:
         monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1200")
         w = bench_mod._WedgeWatchdog(start_thread=False)
         assert w.budget == 1320.0
+
+
+class TestDeviceSync:
+    """Contracts for the readback timing barrier (benchmarks/common.py).
+
+    The axon tunnel's block_until_ready lies (timing_audit: 113,556x
+    blocked-vs-readback divergence), so device_sync is the only trusted
+    barrier — these pin the behaviors every bench depends on. Runs on
+    the virtual CPU backend (the barrier semantics are backend-neutral:
+    jax.device_get of real bytes)."""
+
+    def test_single_leaf_returns_value(self, world):
+        import jax.numpy as jnp
+
+        from benchmarks.common import device_sync
+
+        assert device_sync(jnp.float32(3.5)) == 3.5
+        assert device_sync(jnp.arange(5.0) + 2) == 2.0  # first element
+
+    def test_multi_leaf_tree_combines_every_leaf(self, world):
+        import jax.numpy as jnp
+
+        from benchmarks.common import device_sync
+
+        tree = {"a": jnp.ones((4, 4)), "b": {"c": jnp.full((3,), 2.0),
+                                             "d": jnp.float32(4.0)}}
+        # one combining program reads element 0 of EVERY leaf: 1+2+4
+        assert device_sync(tree) == 7.0
+
+    def test_disttensor_unwraps(self, world):
+        import numpy as np
+
+        import pytorch_distributed_example_tpu as tdx
+        from benchmarks.common import device_sync
+        from pytorch_distributed_example_tpu.tensor import DistTensor
+
+        g = tdx.distributed._get_default_group()
+        dt = DistTensor.from_process_local(
+            np.full(4, 3.0, np.float32), g
+        )
+        assert device_sync(dt) == 3.0
+
+    def test_errors_propagate_not_swallowed(self, world, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        import benchmarks.common as common
+
+        # the OOM-surfacing contract: device_get failures (how async
+        # device errors reach the host) must PROPAGATE out of the
+        # barrier — a regression wrapping it in try/except would turn a
+        # dead-tunnel OOM into a silently-"passing" bench
+        def boom(_):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+        monkeypatch.setattr(jax, "device_get", boom)
+        with _pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            common.device_sync(jnp.float32(1))
