@@ -234,6 +234,62 @@ class TestCommHooks:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
 
+    def test_steps_per_call_stateful_hook_matches_sequential(
+            self, convnet_setup, world):
+        """PowerSGD's error-feedback state threads through the fused
+        scan identically to the sequential schedule — params AND hook
+        state match after K steps."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.data import SyntheticMNIST
+        from pytorch_distributed_example_tpu.parallel.comm_hooks import (
+            PowerSGDHook,
+        )
+
+        model, params = convnet_setup
+        K = 3
+        ds = SyntheticMNIST(512)
+        xs_np, ys_np = ds[np.arange(K * 64)]
+        xs = jnp.asarray(xs_np).reshape((K, 64) + xs_np.shape[1:])
+        ys = jnp.asarray(ys_np).reshape((K, 64))
+        keys = jax.random.split(jax.random.PRNGKey(7), K)
+        loss_fn = _loss_fn()
+        opt = optax.sgd(0.1)
+
+        ddp1 = tdx.DistributedDataParallel(model, params)
+        ddp1.register_comm_hook(None, PowerSGDHook(rank=2))
+        s1 = ddp1.make_train_step(opt, loss_fn, has_rng=True)
+        hs = s1.init_hook_state(ddp1.params)
+        p, o = ddp1.params, opt.init(ddp1.params)
+        for i in range(K):
+            p, o, hs, _l = s1(p, o, hs, xs[i], ys[i], keys[i])
+
+        ddp2 = tdx.DistributedDataParallel(model, params)
+        ddp2.register_comm_hook(None, PowerSGDHook(rank=2))
+        sK = ddp2.make_train_step(
+            opt, loss_fn, has_rng=True, steps_per_call=K
+        )
+        hs2 = sK.init_hook_state(ddp2.params)
+        pk, _ok, hsk, losses = sK(
+            ddp2.params, opt.init(ddp2.params), hs2, xs, ys, keys
+        )
+
+        assert losses.shape == (K,)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(pk)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(hs), jax.tree_util.tree_leaves(hsk)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
     def test_steps_per_call_no_rng(self, convnet_setup, world):
         """The has_rng=False path stacks dummy keys internally."""
         import jax.numpy as jnp
