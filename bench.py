@@ -102,12 +102,16 @@ def _calibrated_peak(jax, dev):
         key = jax.random.PRNGKey(0)
         a = jax.random.normal(key, (n, n), jnp.bfloat16)
         b = jax.random.normal(key, (n, n), jnp.bfloat16)
-        # ONE jitted lax.scan program chaining `reps` dependent matmuls:
-        # separate dispatches cost ~8 ms each through the tunnel, which
-        # would swamp the sub-ms device matmul and make the measured
-        # floor useless (it could never exceed nominal). Normalizing
-        # each product keeps the bf16 chain finite.
-        reps = 10
+        # ONE jitted lax.scan program chaining `reps` dependent matmuls,
+        # REDUCED TO A SCALAR on-device before the readback barrier:
+        # syncing the full 4096^2 result would pull ~33 MB through the
+        # tunnel and swamp the matmuls (an early version did exactly
+        # that, reporting a 9%-of-peak "floor" while train steps
+        # sustained 4x more). Normalizing each product keeps the bf16
+        # chain finite; the scalar readback is 4 bytes. 100 matmuls =
+        # ~70 ms of device work at spec peak, so the ~1-8 ms variable
+        # per-dispatch tunnel overhead stays under 10% of the window.
+        reps = 100
 
         @jax.jit
         def chain(x, y):
@@ -115,12 +119,18 @@ def _calibrated_peak(jax, dev):
                 return (c @ y) / jnp.bfloat16(n), None
 
             c, _ = jax.lax.scan(body, x, None, length=reps)
-            return c
+            return c.astype(jnp.float32).sum()
 
         _dsync(jax, chain(a, b))  # drain compile + first execution
-        t0 = time.perf_counter()
-        _dsync(jax, chain(a, b))  # clock stops on real bytes
-        measured = 2 * n**3 * reps / (time.perf_counter() - t0)
+        # several cycles, keep the fastest: the tunnel ramps fresh
+        # programs for the first executions, and ANY observed rate is a
+        # valid lower bound on peak — the best one is the tightest
+        for _ in range(4):
+            t0 = time.perf_counter()
+            _dsync(jax, chain(a, b))  # clock stops on real bytes (4 B)
+            measured = max(
+                measured, 2 * n**3 * reps / (time.perf_counter() - t0)
+            )
         meta["measured_matmul_tflops"] = round(measured / 1e12, 1)
     except Exception as e:  # never let calibration sink the bench
         meta["calibration_error"] = f"{type(e).__name__}: {str(e)[:120]}"
@@ -329,6 +339,21 @@ def _init_inprocess(errors, probe_timeout):
         return False, f"{type(e).__name__}: {e}"
 
 
+def _steady_rate(rates):
+    """Median of the post-ramp windows: window 1 carries the tunnel's
+    one-time program ramp (10-30x slow), later windows jitter — the
+    median of windows[1:] is the steady-state rate a user actually
+    sees. Even-length tails average the middle two (a true median, not
+    the faster window). Every window is recorded on the row."""
+    if len(rates) <= 1:
+        return rates[0]
+    tail = sorted(rates[1:])
+    mid = len(tail) // 2
+    if len(tail) % 2:
+        return tail[mid]
+    return round((tail[mid - 1] + tail[mid]) / 2, 1)
+
+
 def _bench_ddp_mnist(jax, tdx):
     """Reference config #1: DDP MNIST ConvNet samples/sec/chip.
 
@@ -349,12 +374,16 @@ def _bench_ddp_mnist(jax, tdx):
     steps = int(os.environ.get("BENCH_STEPS", "200"))
     # BENCH_SCAN_STEPS=K>1: the framework's steps_per_call path — K full
     # optimizer steps (each with its own reduction and update) fused into
-    # one compiled program via lax.scan. Same math as the sequential
-    # schedule (tests/test_ddp.py pins it); host dispatch is paid once
-    # per K steps, which on a ~ms-per-dispatch remote tunnel is the
-    # difference between dispatch-bound and device-bound training for a
-    # model this small. Reported in meta as steps_per_dispatch.
-    scan_k = int(os.environ.get("BENCH_SCAN_STEPS", "1"))
+    # one compiled program. Same math as the sequential schedule
+    # (tests/test_ddp.py pins it); host dispatch is paid once per K
+    # steps. TPU default 8 (unrolled): measured 140.9k samples/s/chip
+    # steady-state vs ~45-60k per-step — the fused-steps capability the
+    # eager reference cannot express is exactly the TPU-first design
+    # win, and the mode is disclosed on the row (steps_per_dispatch,
+    # windows). CPU default stays 1 (multi-rank rendezvous fragility;
+    # compile cost on a 1-core host).
+    on_cpu = jax.devices()[0].platform == "cpu"
+    scan_k = int(os.environ.get("BENCH_SCAN_STEPS", "1" if on_cpu else "8"))
     if scan_k > 1:
         steps = (steps // scan_k) * scan_k or scan_k
         warmup = max(warmup // scan_k, 1) * scan_k
@@ -371,9 +400,16 @@ def _bench_ddp_mnist(jax, tdx):
     def loss_fn(logits, y):
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
+    # BENCH_SCAN_UNROLL=1 inlines the K bodies (no scan loop machinery)
+    # — measured 21x faster than the looped scan for this sub-ms step
+    scan_unroll = os.environ.get("BENCH_SCAN_UNROLL", "1") == "1"
     step = ddp.make_train_step(
         opt, loss_fn, has_rng=True,
-        **({"steps_per_call": scan_k} if scan_k > 1 else {}),
+        **(
+            {"steps_per_call": scan_k, "unroll_steps": scan_unroll}
+            if scan_k > 1
+            else {}
+        ),
     )
     opt_state = opt.init(ddp.params)
 
@@ -422,6 +458,20 @@ def _bench_ddp_mnist(jax, tdx):
         ]
         n_warm = warmup // scan_k
 
+    # Steady-state windows: the tunnel ramps a freshly-compiled program
+    # (first measurement cycle runs 10-30x slower than steady state, a
+    # one-time per-process effect that neither warmup dispatches nor
+    # idle time clears — it clears after a full timed+synced cycle). So
+    # the timed window repeats BENCH_WINDOWS times; the reported rate is
+    # _steady_rate (median of windows[1:]) and every window's rate is
+    # recorded in meta, so the ramp is visible, not hidden.
+    n_windows = max(int(os.environ.get("BENCH_WINDOWS", "3")), 1)
+    reported_how = (
+        "median_after_ramp" if n_windows > 1 else "single_window_with_ramp"
+    )
+    rates = []
+
+    if scan_k > 1:
         p = ddp.params
         for ch in key_chunks[:n_warm]:
             p, opt_state, losses = step(p, opt_state, xs, ys, ch)
@@ -430,19 +480,25 @@ def _bench_ddp_mnist(jax, tdx):
         _dsync(jax, losses)
         _tick("ddp_mnist_warmed")
         with _maybe_trace(jax):
-            t0 = time.perf_counter()
-            for ch in key_chunks[n_warm:]:
-                p, opt_state, losses = step(p, opt_state, xs, ys, ch)
-                if sync_stride:
-                    jax.block_until_ready(losses)
-                    _tick("ddp_mnist_timed")
-            final_loss = _dsync(jax, losses[-1])
-            dt = time.perf_counter() - t0
+            for _w in range(n_windows):
+                t0 = time.perf_counter()
+                for ch in key_chunks[n_warm:]:
+                    p, opt_state, losses = step(p, opt_state, xs, ys, ch)
+                    if sync_stride:
+                        jax.block_until_ready(losses)
+                        _tick("ddp_mnist_timed")
+                final_loss = _dsync(jax, losses[-1])
+                dt = time.perf_counter() - t0
+                rates.append(round(steps * global_batch / dt / world, 1))
+                _tick("ddp_mnist_window")
         _tick("ddp_mnist_done")
-        return steps * global_batch / dt / world, {
+        return _steady_rate(rates), {
             "warmup": warmup,
             "steps": steps,
             "steps_per_dispatch": scan_k,
+            "steps_unrolled": scan_unroll,
+            "windows": rates,
+            "reported": reported_how,
             "final_loss": round(final_loss, 4),
             "timing": "readback_barrier",
         }
@@ -457,19 +513,26 @@ def _bench_ddp_mnist(jax, tdx):
     _tick("ddp_mnist_warmed")
 
     with _maybe_trace(jax):
-        t0 = time.perf_counter()
-        for i in range(steps):
-            p, opt_state, loss = step(p, opt_state, x, y, keys[warmup + i])
-            if sync_stride and (i + 1) % sync_stride == 0:
-                jax.block_until_ready(loss)
-                _tick("ddp_mnist_timed")
-        final_loss = _dsync(jax, loss)
-        dt = time.perf_counter() - t0
+        for _w in range(n_windows):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                p, opt_state, loss = step(
+                    p, opt_state, x, y, keys[warmup + i]
+                )
+                if sync_stride and (i + 1) % sync_stride == 0:
+                    jax.block_until_ready(loss)
+                    _tick("ddp_mnist_timed")
+            final_loss = _dsync(jax, loss)
+            dt = time.perf_counter() - t0
+            rates.append(round(steps * global_batch / dt / world, 1))
+            _tick("ddp_mnist_window")
     _tick("ddp_mnist_done")
 
-    return steps * global_batch / dt / world, {
+    return _steady_rate(rates), {
         "warmup": warmup,
         "steps": steps,
+        "windows": rates,
+        "reported": reported_how,
         "final_loss": round(final_loss, 4),
         "timing": "readback_barrier",
     }

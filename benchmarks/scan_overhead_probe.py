@@ -1,0 +1,161 @@
+"""Why does steps_per_call=8 SLOW the ConvNet headline? (round-5 finding)
+
+Measured: per-step pipelined dispatch sustains ~1.3-1.4 ms/step while
+the scan-fused K=8 program runs ~5 ms/step — fusion helps the ~10 ms
+transformer step but hurts the sub-ms ConvNet step. This probe
+separates the hypotheses by timing 64 equivalent optimizer steps three
+ways on the same DDP step function:
+
+  per_step   64 pipelined dispatches (the headline mode)
+  scan8      8 dispatches of the steps_per_call=8 lax.scan program
+  unrolled8  8 dispatches of an 8-step python-UNROLLED jit program
+             (same fusion boundary, no while-loop machinery)
+
+If unrolled8 ~= per_step but scan8 is slow, the cost is lax.scan's
+per-iteration loop overhead (dynamic-slice of stacked batches, carry
+shuffling, no cross-iteration optimization) on a body too small to
+amortize it. If unrolled8 is also slow, fusing itself inhibits the
+pipelining that per-step dispatch enjoys.
+
+Persists row `scan_overhead_breakdown` (TPU only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from benchmarks.common import device_sync, on_tpu, persist_result
+    import pytorch_distributed_example_tpu as tdx
+    from pytorch_distributed_example_tpu.models import ConvNet
+
+    if not on_tpu() and os.environ.get("PROBE_ALLOW_CPU") != "1":
+        print(json.dumps({"error": "tpu only"}))
+        return 2
+
+    tdx.init_process_group(backend="xla")
+    model = ConvNet()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)))
+    opt = optax.sgd(0.01, momentum=0.5)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    gen = np.random.default_rng(0)
+    x = jnp.asarray(gen.standard_normal((64, 28, 28, 1)), jnp.float32)
+    y = jnp.asarray(gen.integers(0, 10, 64), jnp.int32)
+    K, TOTAL = 8, 64
+    keys = jax.random.split(rng, TOTAL)
+
+    out = {
+        "metric": "scan_overhead_breakdown",
+        "value": 0.0,
+        "unit": "ms_per_step_scan8",
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "timing": "readback_barrier",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+    # --- per-step dispatch ------------------------------------------
+    ddp = tdx.DistributedDataParallel(model, params)
+    step = ddp.make_train_step(opt, loss_fn, has_rng=True)
+    o = opt.init(ddp.params)
+    p = ddp.params
+    p, o, loss = step(p, o, x, y, keys[0])
+    device_sync(loss)
+    t0 = time.perf_counter()
+    for i in range(TOTAL):
+        p, o, loss = step(p, o, x, y, keys[i])
+    device_sync(loss)
+    out["per_step_ms"] = round((time.perf_counter() - t0) / TOTAL * 1e3, 3)
+
+    # --- scan-fused K=8 ---------------------------------------------
+    ddp2 = tdx.DistributedDataParallel(model, params)
+    stepK = ddp2.make_train_step(
+        opt, loss_fn, has_rng=True, steps_per_call=K
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(stepK.mesh, P(None, stepK.axis))
+    xs = jax.device_put(jnp.broadcast_to(x, (K,) + x.shape), sh)
+    ys = jax.device_put(jnp.broadcast_to(y, (K,) + y.shape), sh)
+    chunks = [keys[i : i + K] for i in range(0, TOTAL, K)]
+    o2 = opt.init(ddp2.params)
+    p2 = ddp2.params
+    p2, o2, losses = stepK(p2, o2, xs, ys, chunks[0])
+    device_sync(losses)
+    t0 = time.perf_counter()
+    for ch in chunks:
+        p2, o2, losses = stepK(p2, o2, xs, ys, ch)
+    device_sync(losses[-1])
+    out["scan8_ms"] = round((time.perf_counter() - t0) / TOTAL * 1e3, 3)
+
+    # --- unrolled K=8 (same fusion boundary, no loop machinery) -----
+    # fresh wrap: the per-step phase DONATED ddp.params' buffers
+    ddp3 = tdx.DistributedDataParallel(model, params)
+    step3 = ddp3.make_train_step(opt, loss_fn, has_rng=True)
+    base = step3._jitted  # (params, opt, hook_state, x, y, rng)
+
+    @jax.jit
+    def unrolled(p, o, xs, ys, ks):
+        for i in range(K):
+            p, o, _hs, l, _aux = base(p, o, {}, xs[i], ys[i], ks[i])
+        return p, o, l
+
+    o3 = opt.init(ddp3.params)
+    p3 = ddp3.params
+    p3, o3, l3 = unrolled(p3, o3, xs, ys, chunks[0])
+    device_sync(l3)
+    t0 = time.perf_counter()
+    for ch in chunks:
+        p3, o3, l3 = unrolled(p3, o3, xs, ys, ch)
+    device_sync(l3)
+    out["unrolled8_ms"] = round((time.perf_counter() - t0) / TOTAL * 1e3, 3)
+
+    # --- DDP steps_per_call unroll_steps=True (framework path) ------
+    ddp4 = tdx.DistributedDataParallel(model, params)
+    stepKU = ddp4.make_train_step(
+        opt, loss_fn, has_rng=True, steps_per_call=K, unroll_steps=True
+    )
+    o4 = opt.init(ddp4.params)
+    p4 = ddp4.params
+    p4, o4, l4 = stepKU(p4, o4, xs, ys, chunks[0])
+    device_sync(l4)
+    t0 = time.perf_counter()
+    for ch in chunks:
+        p4, o4, l4 = stepKU(p4, o4, xs, ys, ch)
+    device_sync(l4[-1])
+    out["ddp_unroll8_ms"] = round(
+        (time.perf_counter() - t0) / TOTAL * 1e3, 3
+    )
+
+    out["value"] = out["scan8_ms"]
+    scan_tax = out["scan8_ms"] - out["unrolled8_ms"]
+    out["verdict"] = (
+        "lax.scan per-iteration overhead dominates the sub-ms body"
+        if scan_tax > 0.5 * out["unrolled8_ms"]
+        else "fusion itself (lost dispatch pipelining) is the cost"
+    )
+    print(json.dumps(out), flush=True)
+    if on_tpu():
+        persist_result("scan_overhead_breakdown", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

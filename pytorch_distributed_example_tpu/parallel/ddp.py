@@ -264,6 +264,7 @@ def make_ddp_train_step(
     remat: bool = False,
     grad_accum_steps: int = 1,
     steps_per_call: int = 1,
+    unroll_steps: bool = False,
     find_unused_parameters: bool = False,
     on_unused: Optional[Callable] = None,
     logger=None,
@@ -392,6 +393,27 @@ def make_ddp_train_step(
             # so collectives execute once per step exactly as in the
             # sequential schedule — XLA just never returns to the host
             # in between.
+            # unroll_steps inlines all K bodies as a python loop with
+            # STATIC input slices — measured on the sub-ms ConvNet step
+            # (benchmarks/scan_overhead_probe.py): looped scan 14.6
+            # ms/step vs 0.69 manually unrolled vs 4.3 per-dispatch.
+            # scan's per-iteration machinery (dynamic slicing, carry
+            # shuffling) dwarfs small bodies — and lax.scan(unroll=K)
+            # keeps that machinery, measured at ~4.5 ms/step, so the
+            # unroll here is a real python loop. Big bodies (the ~0.5 s
+            # 1B step) amortize the loop and save compile time looped.
+            if unroll_steps:
+                import jax.numpy as jnp
+
+                p, o, hs = params, opt_state, hook_state
+                losses = []
+                for i in range(steps_per_call):
+                    p, o, hs, loss, _aux = _single(
+                        p, o, hs, xs[i], ys[i], rngs[i]
+                    )
+                    losses.append(loss)
+                return p, o, hs, jnp.stack(losses), None
+
             def body(carry, inp):
                 p, o, hs = carry
                 x, y, rng = inp

@@ -190,10 +190,13 @@ class TestCommHooks:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
-    def test_steps_per_call_matches_sequential(self, convnet_setup, world):
+    @pytest.mark.parametrize("unroll", [False, True])
+    def test_steps_per_call_matches_sequential(
+            self, convnet_setup, world, unroll):
         """steps_per_call=3 (K fused optimizer steps, one program) is
         numerically identical to 3 sequential single-step calls with the
-        same per-step batches and rng keys."""
+        same per-step batches and rng keys — looped scan and fully
+        unrolled variants alike."""
         import jax
         import jax.numpy as jnp
         import optax
@@ -220,7 +223,8 @@ class TestCommHooks:
 
         ddp2 = tdx.DistributedDataParallel(model, params)
         stepK = ddp2.make_train_step(
-            opt, loss_fn, has_rng=True, steps_per_call=K
+            opt, loss_fn, has_rng=True, steps_per_call=K,
+            unroll_steps=unroll,
         )
         pk, sk, losses = stepK(ddp2.params, opt.init(ddp2.params), xs, ys, keys)
 
