@@ -19,6 +19,12 @@ from pytorch_distributed_example_tpu import distributed as dist
 
 @pytest.fixture
 def pg():
+    # Order-tolerant: earlier files may hold the session-scoped `world`
+    # default group (conftest). Reuse it and DON'T destroy it — tearing
+    # down the session group would break every later world-based test.
+    if tdx.is_initialized():
+        yield dist._get_default_group()
+        return
     g = tdx.init_process_group(backend="xla")
     yield g
     tdx.destroy_process_group()
@@ -65,25 +71,29 @@ class TestDebugLevel:
         tdx.set_debug_level(tdx.DebugLevel.OFF)
         assert tdx.get_debug_level() == tdx.DebugLevel.OFF
 
-    def test_detail_wraps_groups(self):
+    def test_detail_wraps_groups(self, pg):
+        """DETAIL auto-wraps group CREATION (torch distributed_c10d.py:
+        5440). Asserted on a new_group rather than a fresh default PG —
+        init_process_group and new_group share the same wrap seam, and
+        re-initializing the default group here would tear down the
+        session-scoped `world` other test files depend on."""
         from pytorch_distributed_example_tpu.backends.wrapper import (
             ProcessGroupWrapper,
         )
 
         tdx.set_debug_level(tdx.DebugLevel.DETAIL)
         try:
-            g = tdx.init_process_group(backend="xla")
-            assert isinstance(g.backend_impl, ProcessGroupWrapper)
+            g2 = tdx.new_group(list(range(pg.size())), backend="xla")
+            assert isinstance(g2.backend_impl, ProcessGroupWrapper)
             # collectives still work through the wrapped backend
             t = tdx.DistTensor.from_rank_fn(
-                lambda r: np.array([float(r + 1)], np.float32)
+                lambda r: np.array([float(r + 1)], np.float32), group=g2
             )
-            tdx.all_reduce(t)
-            W = g.size()
+            tdx.all_reduce(t, group=g2)
+            W = g2.size()
             assert t.numpy()[0][0] == W * (W + 1) / 2
         finally:
             tdx.set_debug_level(tdx.DebugLevel.OFF)
-            tdx.destroy_process_group()
 
     def test_off_does_not_wrap(self, pg):
         from pytorch_distributed_example_tpu.backends.wrapper import (
@@ -141,9 +151,14 @@ class TestErrorTaxonomy:
         assert issubclass(StoreTimeoutError, tdx.DistStoreError)
         assert issubclass(StoreTimeoutError, TimeoutError)  # old excepts hold
 
-    def test_unknown_backend_raises_taxonomy(self):
+    def test_unknown_backend_raises_taxonomy(self, pg):
+        # via new_group: with the session default PG alive, a second
+        # init_process_group raises "initialized twice" before backend
+        # resolution; the registry's taxonomy is the same on both paths
         with pytest.raises(tdx.DistBackendError):
-            tdx.init_process_group(backend="definitely-not-a-backend")
+            tdx.new_group(
+                list(range(pg.size())), backend="definitely-not-a-backend"
+            )
 
     def test_store_family_exported(self):
         for name in ("TCPStore", "FileStore", "HashStore", "PrefixStore", "Store"):
