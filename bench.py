@@ -351,7 +351,9 @@ def _steady_rate(rates):
     mid = len(tail) // 2
     if len(tail) % 2:
         return tail[mid]
-    return round((tail[mid - 1] + tail[mid]) / 2, 1)
+    # no rounding here: callers feed rates at any scale (samples/s or
+    # 1/ms) and round for display themselves
+    return (tail[mid - 1] + tail[mid]) / 2
 
 
 def _bench_ddp_mnist(jax, tdx):

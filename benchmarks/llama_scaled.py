@@ -148,15 +148,28 @@ def run_mfu(args):
     wtick("mfu_warmed")
     # BENCH_TRACE=<dir>: same knob and wrapper as bench.py — the timed
     # steps land on a jax.profiler timeline (flash custom-calls visible)
-    from bench import _maybe_trace
+    from bench import _maybe_trace, _steady_rate
 
+    # BENCH_WINDOWS repeated timed windows (default 3): the tunnel ramps
+    # freshly-compiled programs for their first timed+synced cycle, so
+    # the reported step time is the median of post-ramp windows, with
+    # every window's ms recorded on the row (same methodology and
+    # rationale as bench.py's headline).
+    n_windows = max(int(os.environ.get("BENCH_WINDOWS", "3")), 1)
+    window_ms = []
     with _maybe_trace(jax):
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            params, opt_state, loss = step(params, opt_state, toks)
-        final_loss = device_sync(loss)
-    wtick("mfu_timed")
-    dt = (time.perf_counter() - t0) / args.steps
+        for _w in range(n_windows):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                params, opt_state, loss = step(params, opt_state, toks)
+            final_loss = device_sync(loss)
+            window_ms.append(
+                round((time.perf_counter() - t0) / args.steps * 1e3, 1)
+            )
+            wtick("mfu_timed")
+    # _steady_rate picks the median of the post-ramp windows; it operates
+    # on rates, so feed 1/ms and invert back
+    dt = 1.0 / _steady_rate([1.0 / m for m in window_ms]) / 1e3
 
     flops = _analytic_flops(n_params, cfg.n_layers, cfg.d_model, L, B * L)
     mfu = flops / dt / peak if peak else 0.0
@@ -168,6 +181,8 @@ def run_mfu(args):
         tflops=round(flops / dt / 1e12, 2),
         tokens_per_sec=round(B * L / dt, 1),
         step_ms=round(dt * 1e3, 1),
+        window_step_ms=window_ms,
+        reported="median_after_ramp" if n_windows > 1 else "single_window",
         batch=B,
         seq=L,
         remat=not args.no_remat,
