@@ -107,43 +107,28 @@ def main() -> int:
             return 2
         kind = getattr(dev, "device_kind", dev.platform)
 
-    # Phase 1: bf16 matmul TFLOP/s. 4096^3*2 = 137 GFLOP/execution.
-    # Timed with a device->host readback barrier, NOT block_until_ready:
-    # the tunnel's readiness signal returns while work is still queued
-    # (benchmarks/timing_audit.py, 113,556x divergence). The rate here is
-    # tunnel-dispatch-bound (~8 ms/dispatch), so it is a LOWER bound on
-    # device matmul throughput, labeled as such.
+    # Phase 1: bf16 matmul TFLOP/s — bench._calibrated_peak's chain (one
+    # jitted 100-matmul scan, scalar-reduced before the readback barrier
+    # so neither per-dispatch overhead nor a 33 MB result transfer
+    # swamps the matmuls; best of 4 cycles since the tunnel ramps fresh
+    # programs). A lower bound on device peak, shared with every MFU
+    # row's denominator so the numbers agree by construction.
     with _Watchdog(float(os.environ.get("QUICK_MM_BUDGET", "180")), "matmul"):
         sys.path.insert(0, ROOT)
-        from benchmarks.common import device_sync
+        from bench import _calibrated_peak
 
-        n = 4096
-        key = jax.random.PRNGKey(0)
-        a = jax.random.normal(key, (n, n), jnp.bfloat16)
-        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
-
-        @jax.jit
-        def mm(a, b):
-            # normalize so the 10-deep bf16 chain stays finite
-            return (a @ b) / jnp.bfloat16(n)
-
-        device_sync(mm(a, b))  # drain compile + first execution
-        reps = 10
-        t0 = time.perf_counter()
-        out = a
-        for _ in range(reps):
-            out = mm(out, b)
-        chk = device_sync(out)  # clock stops on real bytes
-        dt = time.perf_counter() - t0
-        tflops = 2 * n**3 * reps / dt / 1e12
+        _peak, cal = _calibrated_peak(jax, dev)
+        tflops = cal.get("measured_matmul_tflops", 0.0)
         row = {
             "metric": "bf16_matmul_tflops",
-            "value": round(tflops, 1),
+            "value": tflops,
             "unit": "TFLOP/s",
-            "n": n,
+            "n": 4096,
             "timing": "readback_barrier",
-            "note": "per-dispatch tunnel overhead bound; device lower bound",
-            "checksum_finite": math.isfinite(chk),
+            "note": "scan-chained, scalar-synced, best of 4 cycles; "
+                    "lower bound on device peak",
+            "peak_calibration": cal,
+            "checksum_finite": math.isfinite(tflops) and tflops > 0,
             "platform": dev.platform,
             "device_kind": kind,
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -175,10 +160,7 @@ def main() -> int:
             "value": round(per_chip, 1),
             "unit": "samples/s/chip",
             "world": world,
-            "warmup": meta["warmup"],
-            "steps": meta["steps"],
-            "final_loss": meta.get("final_loss"),
-            "timing": meta.get("timing"),
+            **meta,  # warmup/steps/windows/steps_per_dispatch/... all disclosed
             "vs_baseline": round(per_chip / base, 3) if base else 0.0,
             "platform": dev.platform,
             "device_kind": kind,
