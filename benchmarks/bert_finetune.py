@@ -97,6 +97,9 @@ def main():
         tokens_per_sec_per_chip=round(per_chip * args.seq, 1),
         dtype=str(jnp.dtype(dtype).name),
         loss=round(float(loss), 4),
+        platform=jax.devices()[0].platform,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+        timing="readback_barrier",
     )
 
 

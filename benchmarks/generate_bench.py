@@ -96,6 +96,9 @@ def main():
         dtype=str(jnp.dtype(dtype).name),
         per_seq_tokens_per_sec=round((args.new - 1) / dt, 1),
         prefill_ms=round(dt_prefill * 1e3, 1),
+        platform=jax.devices()[0].platform,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+        timing="readback_barrier",
     )
 
 

@@ -110,6 +110,9 @@ def main():
         batch_per_chip=args.batch,
         dtype=str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
         loss=round(float(loss), 4),
+        platform=jax.devices()[0].platform,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+        timing="readback_barrier",
     )
 
 

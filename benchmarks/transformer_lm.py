@@ -119,6 +119,9 @@ def main():
         params_m=round(n_params / 1e6, 1),
         model_tflops_per_sec=round(flops / dt / 1e12, 2),
         loss=round(float(loss), 4),
+        platform=jax.devices()[0].platform,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+        timing="readback_barrier",
     )
 
 
