@@ -238,11 +238,12 @@ class TestCommHooks:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
 
+    @pytest.mark.parametrize("unroll", [False, True])
     def test_steps_per_call_stateful_hook_matches_sequential(
-            self, convnet_setup, world):
+            self, convnet_setup, world, unroll):
         """PowerSGD's error-feedback state threads through the fused
-        scan identically to the sequential schedule — params AND hook
-        state match after K steps."""
+        program identically to the sequential schedule — params AND hook
+        state match after K steps, looped and unrolled alike."""
         import jax
         import jax.numpy as jnp
         import optax
@@ -273,7 +274,8 @@ class TestCommHooks:
         ddp2 = tdx.DistributedDataParallel(model, params)
         ddp2.register_comm_hook(None, PowerSGDHook(rank=2))
         sK = ddp2.make_train_step(
-            opt, loss_fn, has_rng=True, steps_per_call=K
+            opt, loss_fn, has_rng=True, steps_per_call=K,
+            unroll_steps=unroll,
         )
         hs2 = sK.init_hook_state(ddp2.params)
         pk, _ok, hsk, losses = sK(
