@@ -32,6 +32,11 @@ def main():
         "--blocks", default="128,256,512",
         help="comma-separated candidate block sizes",
     )
+    ap.add_argument(
+        "--skip-dense", action="store_true",
+        help="skip the dense-attention comparison (long sequences: the "
+             "dense L^2 score matrix OOMs exactly where flash shines)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -52,37 +57,39 @@ def main():
     v = jnp.asarray(gen.standard_normal(shape), dtype)
 
     def timed(fn_one):
-        # `fn_one: q -> same-shaped array`. Two tunnel artifacts shape
-        # this harness (benchmarks/timing_audit.py): block_until_ready
-        # LIES (readback barriers instead), and each dispatch costs ~8 ms
-        # — 10-100x these kernels — so the iterations are chained inside
-        # ONE jitted lax.scan program: one dispatch, `iters` dependent
-        # kernel executions, and the clock stops on real bytes.
+        # `fn_one: (q, k, v) -> q-shaped array`. Three tunnel artifacts
+        # shape this harness (benchmarks/timing_audit.py):
+        # block_until_ready LIES (readback barriers instead); each
+        # dispatch costs ~8 ms — 10-100x these kernels — so iterations
+        # chain inside ONE jitted lax.scan program; and k/v must be
+        # explicit ARGUMENTS, not closure captures — captured arrays
+        # embed as HLO constants and blow the remote-compile body limit
+        # (HTTP 413) at long sequences.
         @jax.jit
-        def chained(x):
+        def chained(x, kk, vv):
             def body(c, _):
-                return fn_one(c).astype(x.dtype), None
+                return fn_one(c, kk, vv).astype(x.dtype), None
             c, _ = jax.lax.scan(body, x, None, length=args.iters)
             return c
-        device_sync(chained(q))  # drain compile + first execution
+        device_sync(chained(q, k, v))  # drain compile + first execution
         wtick("sweep_compiled")
         t0 = time.perf_counter()
-        device_sync(chained(q))
+        device_sync(chained(q, k, v))
         wtick("sweep_timed")
         return (time.perf_counter() - t0) / args.iters * 1e3  # ms
 
     cands = [int(b) for b in args.blocks.split(",") if args.seq % int(b) == 0]
     table = {}
     for bq, bk in itertools.product(cands, cands):
-        def fwd_one(x, bq=bq, bk=bk):
+        def fwd_one(x, kk, vv, bq=bq, bk=bk):
             return flash_attention(
-                x, k, v, causal=args.causal, block_q=bq, block_k=bk
+                x, kk, vv, causal=args.causal, block_q=bq, block_k=bk
             )
 
-        def bwd_one(x, bq=bq, bk=bk):
+        def bwd_one(x, kk, vv, bq=bq, bk=bk):
             return jax.grad(
                 lambda xx: flash_attention(
-                    xx, k, v, causal=args.causal, block_q=bq, block_k=bk
+                    xx, kk, vv, causal=args.causal, block_q=bq, block_k=bk
                 ).astype(jnp.float32).sum()
             )(x)
 
@@ -94,9 +101,17 @@ def main():
         except Exception as e:  # VMEM overflow etc.: record, keep sweeping
             table[f"{bq}x{bk}"] = {"error": f"{type(e).__name__}"}
 
-    dense_ms = round(
-        timed(lambda x: dense_attention(x, k, v, causal=args.causal)), 3
-    )
+    if args.skip_dense:
+        dense_ms = None  # skipped, not measured-zero
+    else:
+        dense_ms = round(
+            timed(
+                lambda x, kk, vv: dense_attention(
+                    x, kk, vv, causal=args.causal
+                )
+            ),
+            3,
+        )
 
     ok = {k: v for k, v in table.items() if "fwd_ms" in v}
     best_fwd = min(ok, key=lambda k: ok[k]["fwd_ms"]) if ok else None
@@ -109,8 +124,10 @@ def main():
         best_train_blocks=best_train,  # may differ: pick per workload
         best_train_fwd_bwd_ms=ok[best_train]["fwd_bwd_ms"] if best_train else 0.0,
         dense_fwd_ms=dense_ms,
+        dense_skipped=args.skip_dense,
         speedup_vs_dense=(
-            round(dense_ms / ok[best_fwd]["fwd_ms"], 2) if best_fwd else 0.0
+            round(dense_ms / ok[best_fwd]["fwd_ms"], 2)
+            if (best_fwd and dense_ms) else None
         ),
         table=table,
         seq=args.seq,

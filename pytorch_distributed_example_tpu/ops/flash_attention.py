@@ -111,6 +111,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q,k,v: (BH, L, D) → (o, lse)."""
     BH, L, D = q.shape
+    if _use_streaming(L, D, q.dtype.itemsize):
+        return _fwd_streamed(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
     grid = (BH, L // block_q)
 
     kernel = functools.partial(
@@ -137,6 +140,135 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((BH, L, D), q.dtype),
             jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
         ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# streamed variants: k/v blocks ride the GRID instead of sitting whole in
+# VMEM. The resident kernels above hold the full counterpart operand in
+# VMEM (k/v for fwd/dq, q/do for dkdv), which is fastest while it fits but
+# exceeds the ~16 MB scoped-VMEM limit near L·D ≈ 1.5M elements (measured:
+# L=16384, D=128 OOMs at 16.75M needed). Past `_stream_threshold` the
+# pallas grid gains a third dimension over counterpart blocks; the online
+# accumulators live in VMEM scratch that persists across the innermost
+# (ARBITRARY) grid dimension, and outputs are written at its last step —
+# the standard TPU flash streaming scheme. O(block) VMEM at any L.
+# ---------------------------------------------------------------------------
+
+
+def _stream_threshold_elems(itemsize: int) -> int:
+    """Counterpart-residency limit in ELEMENTS of one (L, D) operand.
+    Default 6 MB across the two resident operands (k+v, double-buffered
+    pairs then stay under the 16 MB scoped limit); dtype-aware — fp32
+    halves the element budget. TDX_FLASH_STREAM=1/0 forces on/off."""
+    import os
+
+    mb = float(os.environ.get("TDX_FLASH_VMEM_MB", "6"))
+    return int(mb * (1 << 20) / 2 / itemsize)
+
+
+def _use_streaming(L: int, D: int, itemsize: int = 2) -> bool:
+    import os
+
+    env = os.environ.get("TDX_FLASH_STREAM")
+    if env is not None:
+        return env == "1"
+    return L * D > _stream_threshold_elems(itemsize)
+
+
+def _fwd_kernel_streamed(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+    *, scale, causal, block_q, block_k,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_start = i * block_q
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_s[:, 0]
+        l = l_s[:, 0]
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_s[...] = acc_s[...] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_s[:, 0] = m_new
+        l_s[:, 0] = l_new
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; their
+        # grid steps skip the compute (the block DMA still happens)
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:, 0] + jnp.log(l_safe))[:, None]
+
+
+def _fwd_streamed(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, L, D = q.shape
+    grid = (BH, L // block_q, L // block_k)
+    kernel = functools.partial(
+        _fwd_kernel_streamed,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                                 pltpu.ARBITRARY),
+        ),
         interpret=interpret,
     )(q, k, v)
     return o, lse
@@ -220,8 +352,178 @@ def _bwd_dq_kernel(
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
+def _bwd_dkdv_kernel_streamed(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_s, dv_s, *, scale, causal, block_q, block_k,
+):
+    j = pl.program_id(1)   # k block (output)
+    i = pl.program_id(2)   # q block (streamed)
+    nq = pl.num_programs(2)
+    k_start = j * block_k
+    q_start = i * block_q
+
+    @pl.when(i == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_s[...] = dv_s[...] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dlogits = p * (dp - delta[:, None])
+        dk_s[...] = dk_s[...] + jnp.dot(
+            dlogits.T, q, preferred_element_type=jnp.float32
+        ) * scale
+
+    if causal:
+        # q blocks entirely above the diagonal see only masked logits
+        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_streamed(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
+    *, scale, causal, block_q, block_k,
+):
+    i = pl.program_id(1)   # q block (output)
+    j = pl.program_id(2)   # k block (streamed)
+    nk = pl.num_programs(2)
+    q_start = i * block_q
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dlogits = p * (dp - delta[:, None])
+        dq_s[...] = dq_s[...] + jnp.dot(
+            dlogits, k, preferred_element_type=jnp.float32
+        ) * scale
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                  interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, L, D = q.shape
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+    sem = pltpu.CompilerParams(
+        dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                             pltpu.ARBITRARY),
+    )
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel_streamed,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, L // block_k, L // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # v
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=sem,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel_streamed,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(BH, L // block_q, L // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # do
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=sem,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
     BH, L, D = q.shape
+    if _use_streaming(L, D, q.dtype.itemsize):
+        return _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q,
+                             block_k, interpret)
     # (BH, L, 1) — same tiling story as lse
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
 
@@ -368,10 +670,15 @@ def flash_attention(
     call or fleet-wide via `TDX_FLASH_BLOCK_Q` / `TDX_FLASH_BLOCK_K` —
     `benchmarks/flash_bench.py` sweeps them on real hardware.
 
-    Constraints: L divisible by block sizes (pad upstream); K/V for one
-    head must fit VMEM (L·D·4 bytes ≤ ~4 MB ⇒ L ≤ 8k at D=128) — the
-    streaming-HBM variant for longer L is ring attention over the mesh
-    (parallel/context_parallel.py), which calls this kernel per shard.
+    Constraints: L divisible by block sizes (pad upstream). Sequence
+    length is otherwise unbounded: past ~L·D·itemsize ≈ 3 MB per
+    operand the kernels switch automatically to the STREAMED variants
+    (k/v blocks ride the pallas grid, O(block) VMEM — measured on
+    hardware at L=64k single-chip, `flash_sweep_L65536_*`). Below that
+    the VMEM-resident kernels are used (fastest while they fit);
+    TDX_FLASH_STREAM=1/0 forces either. Ring attention over the mesh
+    (parallel/context_parallel.py) remains the MULTI-chip long-context
+    path and calls this kernel per shard.
     """
     B, L, H, D = q.shape
     if scale is None:
