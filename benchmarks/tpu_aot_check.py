@@ -332,19 +332,25 @@ def _ring_longctx(topo, L_global=65536, B=1, H=8, D=128):
         (B, L_global, H, D), jnp.bfloat16,
         sharding=NamedSharding(mesh, spec),
     )
+    key = f"aot_ring_attention_{L_global >> 10}k"
     try:
         t0 = time.time()
         compiled = jax.jit(fn).lower(qs, qs, qs).compile()
         compile_s = time.time() - t0
     except Exception as e:
-        emit("aot_ring_attention_64k", 0.0, "GB/device",
+        emit(key, 0.0, "GB/device",
              error=f"{type(e).__name__}: {str(e)[:300]}")
         return
     mem = _mem(compiled)
-    flops, bytes_acc = _cost(compiled)
+    flops_xla, bytes_acc = _cost(compiled)
+    # XLA counts Pallas custom calls as ZERO flops (the _ceiling_row
+    # pitfall); when the ring's local block is the flash kernel, the
+    # analytic count is the honest number: causal global attention fwd
+    # = 2 matmuls over the lower triangle = 2 * B * H * Lg^2 * D.
+    flops_analytic = 2.0 * B * H * float(L_global) ** 2 * D
     total = mem["argument_size_in_bytes"] + mem["temp_size_in_bytes"]
     rec = emit(
-        "aot_ring_attention_64k",
+        key,
         round(total / 1e9, 3),
         "GB/device",
         evidence="aot_compile_only",
@@ -353,13 +359,19 @@ def _ring_longctx(topo, L_global=65536, B=1, H=8, D=128):
         n_devices=len(devs),
         heads=H,
         head_dim=D,
-        hw_flops=flops,
+        hw_flops_xla_counted=flops_xla,
+        fwd_flops_analytic=flops_analytic,
+        flops_note=(
+            "cost_analysis counts pallas custom calls as zero; when the "
+            "local block lowers to the flash kernel, fwd_flops_analytic "
+            "is the real work"
+        ),
         memory=mem,
         compile_s=round(compile_s, 1),
         fits_16gb_hbm=bool(total < 16e9),
         device_kind=devs[0].device_kind,
     )
-    persist_result("aot_ring_attention_64k", rec)
+    persist_result(key, rec)
 
 
 def main():

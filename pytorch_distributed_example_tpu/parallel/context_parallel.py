@@ -56,6 +56,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    block_kernel: str = "auto",
 ):
     """Blockwise ring attention inside shard_map (seq axis sharded).
 
@@ -67,6 +68,17 @@ def ring_attention(
     by rank (r - s) mod W; after the partial accumulation the shard moves to
     rank r+1 (`ppermute`). Streaming softmax rescaling keeps the
     accumulator exact (flash-attention style).
+
+    `block_kernel`: how the LOCAL (Lq x Lk) partial is computed.
+      "dense"  the einsum block (materializes the local score matrix —
+               fine for the short shards of a wide mesh);
+      "flash"  the Pallas flash kernel per block, combined exactly via
+               per-block (o, lse) logaddexp — O(block) memory, which is
+               what makes 64k-token SHARDS (512k global on 8 chips)
+               compile where dense would need a 64k x 64k score matrix;
+      "auto"   flash when a shard's scores would exceed ~256 MB and the
+               shapes meet the kernel's block-divisibility contract,
+               else dense.
     """
     import jax
     import jax.numpy as jnp
@@ -78,6 +90,20 @@ def ring_attention(
     Lk = k.shape[1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+
+    if block_kernel == "auto":
+        from ..ops.flash_attention import resolved_block_sizes
+
+        bq, bk = resolved_block_sizes(min(Lq, Lk))
+        divisible = Lq % bq == 0 and Lk % bk == 0 and Lq == Lk
+        # dense materializes (B, H, Lq, Lk) f32 scores per ring step
+        big = B * H * Lq * Lk * 4 > 256 * (1 << 20)
+        block_kernel = "flash" if (divisible and big) else "dense"
+
+    if block_kernel == "flash":
+        return _ring_attention_flash(
+            q, k, v, axis_name, causal, scale, W, r
+        )
 
     def mask_for(src_rank):
         if not causal:
@@ -108,6 +134,117 @@ def ring_attention(
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (never happens for causal q>=0)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, W, r):
+    """Ring attention whose local partial is the Pallas FLASH kernel.
+
+    Each ring step produces the flash kernel's (normalized o_b, lse_b)
+    for (local q) x (current kv shard); partials combine EXACTLY via
+    log-sum-exp:  lse' = logaddexp(lse, lse_b),
+    o' = o*exp(lse-lse') + o_b*exp(lse_b-lse').  For causal, the kernel
+    variant is selected per step with `lax.cond` on the shard's origin:
+    the diagonal shard (src == r) runs the causal kernel, shards from
+    earlier ranks run the non-causal kernel, later ranks' shards are
+    fully masked and skipped (lse = -inf). Each variant is one
+    compiled pallas program; at long shards the kernels' streamed
+    lowering engages automatically — together that is what lets a 512k
+    global sequence (8 x 64k shards) compile where the dense block's
+    64k x 64k scores cannot exist.
+
+    FORWARD-ONLY for now: a correct backward must propagate the
+    cotangent that flows into each block's lse through the combine
+    weights (the dense path gets this for free from jax AD); composing
+    the per-block flash VJP alone would silently DROP that term, so
+    differentiation is blocked by `_no_grad_guard` — jax.grad fails at
+    trace time (under shard_map the error may surface as an internal
+    AssertionError rather than this module's NotImplementedError; either
+    way it cannot silently return wrong gradients). Training-time long
+    context uses the dense-block ring, Ulysses, or shorter shards.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.flash_attention import (
+        _fwd,
+        _interpret_default,
+        _to_bh,
+        resolved_block_sizes,
+    )
+
+    B, Lq, H, D = q.shape
+    bq, bk = resolved_block_sizes(Lq)
+    if Lq != k.shape[1] or Lq % bq or Lq % bk:
+        raise ValueError(
+            f"flash block kernel needs equal, block-divisible shard "
+            f"lengths: Lq={Lq} Lk={k.shape[1]} blocks=({bq},{bk}); use "
+            f"block_kernel='dense' or pad the sequence"
+        )
+    interpret = _interpret_default()
+
+    @jax.custom_vjp
+    def _no_grad_guard(x):
+        return x
+
+    def _guard_fwd(x):
+        return x, None
+
+    def _guard_bwd(_res, _g):
+        raise NotImplementedError(
+            "ring_attention(block_kernel='flash') is forward-only: the "
+            "combine's lse cotangent is not yet propagated through the "
+            "flash VJP. Use block_kernel='dense' (exact AD) or "
+            "ulysses_attention for training."
+        )
+
+    _no_grad_guard.defvjp(_guard_fwd, _guard_bwd)
+
+    to_bh = _to_bh
+    qbh = to_bh(q)
+
+    def flash_partial(k_cur, v_cur, src):
+        kbh, vbh = to_bh(k_cur), to_bh(v_cur)
+
+        def diag(_):
+            return _fwd(qbh, kbh, vbh, scale, True, bq, bk, interpret)
+
+        def full(_):
+            return _fwd(qbh, kbh, vbh, scale, False, bq, bk, interpret)
+
+        def skip(_):
+            return (
+                jnp.zeros((B * H, Lq, D), q.dtype),
+                jnp.full((B * H, Lq, 1), NEG_INF, jnp.float32),
+            )
+
+        if not causal:
+            return full(None)
+        return lax.cond(
+            src == r,
+            diag,
+            lambda _: lax.cond(src < r, full, skip, None),
+            None,
+        )
+
+    def body(s, carry):
+        o, lse, k_cur, v_cur = carry
+        src = (r - s) % W
+        o_b, lse_b = flash_partial(k_cur, v_cur, src)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_b - lse_new)
+        o = o * w_old + o_b.astype(jnp.float32) * w_new
+        perm = [(i, (i + 1) % W) for i in range(W)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros((B * H, Lq, D), jnp.float32)
+    lse0 = jnp.full((B * H, Lq, 1), NEG_INF, jnp.float32)
+    o, lse, _, _ = lax.fori_loop(0, W, body, (o0, lse0, k, v))
+    out = o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    return _no_grad_guard(out.astype(q.dtype))
 
 
 def ulysses_attention(
