@@ -453,7 +453,7 @@ def _bwd_dq_kernel_streamed(
 
 
 def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                  interpret):
+                  interpret, dlse=None):
     from jax.experimental.pallas import tpu as pltpu
 
     BH, L, D = q.shape
@@ -461,6 +461,11 @@ def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
         keepdims=True,
     )
+    if dlse is not None:
+        # lse cotangent folds into delta: d_logits = p*(dp - delta)
+        # generalizes to p*(dp - delta + dlse_row), since
+        # d(lse)/d(logits) = softmax(logits) = p
+        delta = delta - dlse.astype(jnp.float32)
     sem = pltpu.CompilerParams(
         dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
                              pltpu.ARBITRARY),
@@ -519,13 +524,17 @@ def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret,
+         dlse=None):
     BH, L, D = q.shape
     if _use_streaming(L, D, q.dtype.itemsize):
         return _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q,
-                             block_k, interpret)
+                             block_k, interpret, dlse=dlse)
     # (BH, L, 1) — same tiling story as lse
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
+    if dlse is not None:
+        # lse cotangent folds into delta (see _bwd_streamed)
+        delta = delta - dlse.astype(jnp.float32)
 
     dkdv = pl.pallas_call(
         functools.partial(
@@ -590,24 +599,45 @@ def _from_bh(x, B, H):
     return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return o
+    # o-only view of flash_with_lse — ONE custom_vjp definition to
+    # maintain; the unused lse output's cotangent arrives as zeros and
+    # costs a negligible (BH, L, 1) subtract in the backward
+    return flash_with_lse(q, k, v, scale, causal, block_q, block_k,
+                          interpret)[0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    """(o, lse) with FULL differentiation through both outputs.
+
+    For compositions that consume the log-sum-exp — ring attention's
+    per-shard partial combine being the motivating one — the lse
+    cotangent must reach the kernels: since d(lse)/d(logits) =
+    softmax(logits) = p, it folds into the existing backward as
+    `delta -> delta - dlse` (dlogits = p*(dp - delta + dlse_row)), so
+    the same three bwd kernels serve both VJPs. Shapes as `_fwd`:
+    (BH, L, D) in, ((BH, L, D), (BH, L, 1)) out.
+    """
+    return _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _fwl_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _fwl_bwd(scale, causal, block_q, block_k, interpret, res, cts):
     q, k, v, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret)
+    do, dlse = cts
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret,
+        dlse=dlse,
+    )
     return dq, dk, dv
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+flash_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 
 
 @functools.lru_cache(maxsize=1)

@@ -152,24 +152,22 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, W, r):
     global sequence (8 x 64k shards) compile where the dense block's
     64k x 64k scores cannot exist.
 
-    FORWARD-ONLY for now: a correct backward must propagate the
-    cotangent that flows into each block's lse through the combine
-    weights (the dense path gets this for free from jax AD); composing
-    the per-block flash VJP alone would silently DROP that term, so
-    differentiation is blocked by `_no_grad_guard` — jax.grad fails at
-    trace time (under shard_map the error may surface as an internal
-    AssertionError rather than this module's NotImplementedError; either
-    way it cannot silently return wrong gradients). Training-time long
-    context uses the dense-block ring, Ulysses, or shorter shards.
+    Fully differentiable: each block goes through
+    `ops.flash_attention.flash_with_lse`, whose VJP propagates BOTH
+    cotangents — the combine's lse cotangent folds into the backward
+    kernels as `delta - dlse` (d(lse)/d(logits) = softmax = p). jax AD
+    then differentiates the logaddexp combine, the lax.cond variant
+    selection, and the ppermute ring exactly (gradient parity vs GLOBAL
+    dense attention pinned in tests, resident and streamed lowerings).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from ..ops.flash_attention import (
-        _fwd,
         _interpret_default,
         _to_bh,
+        flash_with_lse,
         resolved_block_sizes,
     )
 
@@ -183,23 +181,6 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, W, r):
         )
     interpret = _interpret_default()
 
-    @jax.custom_vjp
-    def _no_grad_guard(x):
-        return x
-
-    def _guard_fwd(x):
-        return x, None
-
-    def _guard_bwd(_res, _g):
-        raise NotImplementedError(
-            "ring_attention(block_kernel='flash') is forward-only: the "
-            "combine's lse cotangent is not yet propagated through the "
-            "flash VJP. Use block_kernel='dense' (exact AD) or "
-            "ulysses_attention for training."
-        )
-
-    _no_grad_guard.defvjp(_guard_fwd, _guard_bwd)
-
     to_bh = _to_bh
     qbh = to_bh(q)
 
@@ -207,10 +188,12 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, W, r):
         kbh, vbh = to_bh(k_cur), to_bh(v_cur)
 
         def diag(_):
-            return _fwd(qbh, kbh, vbh, scale, True, bq, bk, interpret)
+            return flash_with_lse(qbh, kbh, vbh, scale, True, bq, bk,
+                                  interpret)
 
         def full(_):
-            return _fwd(qbh, kbh, vbh, scale, False, bq, bk, interpret)
+            return flash_with_lse(qbh, kbh, vbh, scale, False, bq, bk,
+                                  interpret)
 
         def skip(_):
             return (
@@ -244,7 +227,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, W, r):
     lse0 = jnp.full((B * H, Lq, 1), NEG_INF, jnp.float32)
     o, lse, _, _ = lax.fori_loop(0, W, body, (o0, lse0, k, v))
     out = o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
-    return _no_grad_guard(out.astype(q.dtype))
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(

@@ -351,28 +351,54 @@ class TestContextParallel:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
         )
 
-    def test_ring_flash_block_grad_blocked_not_wrong(self):
-        """Differentiating the flash-block ring must FAIL (the combine's
-        lse cotangent is not propagated yet) — never silently return
-        wrong gradients. The dense-block ring remains the AD path."""
+    @pytest.mark.parametrize("stream", [False, True])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_flash_block_grads_match_dense(self, causal, stream,
+                                                monkeypatch):
+        """Flash-block ring gradients are EXACT vs global dense for all
+        of (q, k, v): the lse cotangent from the logaddexp combine folds
+        into the flash backward kernels as `delta - dlse`
+        (`ops.flash_attention.flash_with_lse`), and jax AD handles the
+        cond/fori/ppermute ring around it. `stream=True` forces the
+        STREAMED kernel lowering so the streamed backward's dlse branch
+        is covered too (the 128k-training path's lowering)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from pytorch_distributed_example_tpu._compat import shard_map_fn
 
+        if stream:
+            monkeypatch.setenv("TDX_FLASH_STREAM", "1")
+        else:
+            monkeypatch.delenv("TDX_FLASH_STREAM", raising=False)
         mesh = init_device_mesh(("sp",), (8,))
         gen = np.random.default_rng(8)
-        q = jnp.asarray(gen.standard_normal((1, 1024, 2, 64)), jnp.float32)
+        B, L, H, D = 1, 1024, 2, 64
+        q = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.float32)
+        k = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.float32)
+        v = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.float32)
         spec = P(None, "sp", None, None)
         fn = shard_map_fn(
             lambda q, k, v: ring_attention(
-                q, k, v, axis_name="sp", causal=True, block_kernel="flash"
+                q, k, v, axis_name="sp", causal=causal,
+                block_kernel="flash",
             ),
             mesh=mesh.jax_mesh, in_specs=spec, out_specs=spec,
         )
-        with pytest.raises(Exception):
-            jax.grad(lambda q: jax.jit(fn)(q, q, q).sum())(q)
+        gf = jax.grad(
+            lambda q, k, v: (jax.jit(fn)(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_dense_attention(q, k, v, causal) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4,
+                err_msg=f"d{name} mismatch (flash-block ring)",
+            )
 
     def test_ring_attention_grads_flow(self):
         """jax.grad differentiates through the ring (ppermute transpose)."""
