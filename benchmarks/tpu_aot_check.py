@@ -395,8 +395,80 @@ def main():
                  persist=True)
     # ~1B single-chip config (llama_scaled --mode mfu): L=1024 B=8
     _ceiling_row("aot_ceiling_llama1b_mfu", dev, CFG_1B, 1024, 8, persist=True)
-    # long-context: 64k causal ring attention over the 8-chip topology
+    # long-context: 64k causal ring attention over the 8-chip topology,
+    # the 512k flash-block forward, and fwd+bwd TRAIN compiles through
+    # the custom ring VJP at 256k/512k/1M
     _ring_longctx(topo)
+    _ring_longctx(topo, L_global=524288, B=1, H=16, D=128)
+    for L in (262144, 524288, 1048576):
+        _ring_train_compile(topo, L_global=L, B=1, H=16, D=128)
+
+
+def _ring_train_compile(topo, L_global, B=1, H=16, D=128):
+    """value_and_grad of flash-block ring attention, AOT-compiled for the
+    full topology — generator of the `aot_ring_attention_train_{N}k`
+    rows. The backward is the CUSTOM ring VJP (KV re-rotation, O(local)
+    residuals, `context_parallel._ring_core_bwd`); letting jax
+    reverse-differentiate the forward fori_loop instead saves every ring
+    step's KV shards and needs 17.7 GB/device at 256k."""
+    import numpy as np_
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from benchmarks.common import emit, persist_result
+    from pytorch_distributed_example_tpu._compat import shard_map_fn
+    from pytorch_distributed_example_tpu.parallel.context_parallel import (
+        ring_attention,
+    )
+
+    devs = list(topo.devices)
+    mesh = Mesh(np_.array(devs), ("sp",))
+    spec = P(None, "sp", None, None)
+    fn = shard_map_fn(
+        lambda q, k, v: ring_attention(
+            q, k, v, axis_name="sp", causal=True, block_kernel="flash"
+        ),
+        mesh=mesh, in_specs=spec, out_specs=spec,
+    )
+    g = jax.grad(
+        lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).mean(),
+        argnums=(0, 1, 2),
+    )
+    qs = jax.ShapeDtypeStruct(
+        (B, L_global, H, D), jnp.bfloat16,
+        sharding=NamedSharding(mesh, spec),
+    )
+    key = f"aot_ring_attention_train_{L_global >> 10}k"
+    try:
+        t0 = time.time()
+        compiled = jax.jit(g).lower(qs, qs, qs).compile()
+        compile_s = time.time() - t0
+    except Exception as e:
+        emit(key, 0.0, "GB/device",
+             error=f"{type(e).__name__}: {str(e)[:300]}")
+        return
+    mem = _mem(compiled)
+    total = mem["argument_size_in_bytes"] + mem["temp_size_in_bytes"]
+    rec = emit(
+        key,
+        round(total / 1e9, 3),
+        "GB/device",
+        evidence="aot_compile_only",
+        seq_global=L_global,
+        seq_per_device=L_global // len(devs),
+        n_devices=len(devs),
+        heads=H,
+        head_dim=D,
+        what=("value_and_grad of flash-block ring attention via the "
+              "custom ring VJP (backward re-rotates KV; O(local) "
+              "residuals)"),
+        memory=mem,
+        compile_s=round(compile_s, 1),
+        fits_16gb_hbm=bool(total < 16e9),
+        device_kind=devs[0].device_kind,
+    )
+    persist_result(key, rec)
 
 
 def headline_cfg():

@@ -452,11 +452,136 @@ def _bwd_dq_kernel_streamed(
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                  interpret, dlse=None):
-    from jax.experimental.pallas import tpu as pltpu
+def _dkdv_call(q, k, v, do, lse, delta, scale, causal, block_q, block_k,
+               interpret):
+    """dK/dV for one (q-set, kv-set) pair given PRECOMPUTED lse/delta.
 
+    Chooses the resident or streamed lowering by operand size. Exposed
+    (delta-taking) so the ring backward can reuse it per kv shard with
+    the ring's FINAL lse/delta."""
     BH, L, D = q.shape
+    if _use_streaming(L, D, q.dtype.itemsize):
+        from jax.experimental.pallas import tpu as pltpu
+
+        sem = pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                                 pltpu.ARBITRARY),
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _bwd_dkdv_kernel_streamed,
+                scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k,
+            ),
+            grid=(BH, L // block_k, L // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+                jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            compiler_params=sem,
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            seq_len=L,
+        ),
+        grid=(BH, L // block_k),
+        in_specs=[
+            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # q (full)
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # k block
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # v block
+            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # do (full)
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # lse (full)
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+
+def _dq_call(q, k, v, do, lse, delta, scale, causal, block_q, block_k,
+             interpret):
+    """dQ for one (q-set, kv-set) pair given PRECOMPUTED lse/delta."""
+    BH, L, D = q.shape
+    if _use_streaming(L, D, q.dtype.itemsize):
+        from jax.experimental.pallas import tpu as pltpu
+
+        sem = pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                                 pltpu.ARBITRARY),
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel_streamed,
+                scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k,
+            ),
+            grid=(BH, L // block_q, L // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, D), lambda b, i, j: (b, i, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=sem,
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            seq_len=L,
+        ),
+        grid=(BH, L // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q block
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # k (full)
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # v (full)
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do block
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret,
+         dlse=None):
+    # (BH, L, 1) — same tiling story as lse
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
         keepdims=True,
@@ -466,120 +591,10 @@ def _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         # generalizes to p*(dp - delta + dlse_row), since
         # d(lse)/d(logits) = softmax(logits) = p
         delta = delta - dlse.astype(jnp.float32)
-    sem = pltpu.CompilerParams(
-        dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                             pltpu.ARBITRARY),
-    )
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkdv_kernel_streamed,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        ),
-        grid=(BH, L // block_k, L // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # v
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),  # delta
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        compiler_params=sem,
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel_streamed,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        ),
-        grid=(BH, L // block_q, L // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # q
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # v
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # do
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # delta
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=sem,
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
-
-
-def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret,
-         dlse=None):
-    BH, L, D = q.shape
-    if _use_streaming(L, D, q.dtype.itemsize):
-        return _bwd_streamed(q, k, v, o, lse, do, scale, causal, block_q,
-                             block_k, interpret, dlse=dlse)
-    # (BH, L, 1) — same tiling story as lse
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)
-    if dlse is not None:
-        # lse cotangent folds into delta (see _bwd_streamed)
-        delta = delta - dlse.astype(jnp.float32)
-
-    dkdv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkdv_kernel,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=L,
-        ),
-        grid=(BH, L // block_k),
-        in_specs=[
-            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # q (full)
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # k block
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # v block
-            pl.BlockSpec((1, L, D), lambda b, j: (b, 0, 0)),        # do (full)
-            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # lse (full)
-            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),        # delta (full)
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-        ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    dk, dv = dkdv
-
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=L,
-        ),
-        grid=(BH, L // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q block
-            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # k (full)
-            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),        # v (full)
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do block
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse block
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta block
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    dk, dv = _dkdv_call(q, k, v, do, lse, delta, scale, causal, block_q,
+                        block_k, interpret)
+    dq = _dq_call(q, k, v, do, lse, delta, scale, causal, block_q,
+                  block_k, interpret)
     return dq, dk, dv
 
 

@@ -126,6 +126,49 @@ class TestFlashStreamed:
                 err_msg=f"d{name} mismatch (streamed)",
             )
 
+    @pytest.mark.parametrize("stream", [False, True])
+    def test_flash_with_lse_dlse_gradient(self, stream, monkeypatch):
+        """`flash_with_lse`'s VJP propagates the LSE cotangent (folded
+        into the bwd kernels as `delta - dlse`) — pinned directly, both
+        lowerings, against a dense (o, logsumexp) reference whose loss
+        consumes BOTH outputs."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.ops.flash_attention import (
+            flash_with_lse,
+        )
+
+        monkeypatch.setenv("TDX_FLASH_STREAM", "1" if stream else "0")
+        q, k, v = _rand_qkv(13, B=1, L=256, H=2, D=64)
+        scale = 1.0 / (64 ** 0.5)
+
+        def loss_flash(q, k, v):
+            o, lse = flash_with_lse(q.transpose(0, 2, 1, 3).reshape(2, 256, 64),
+                                    k.transpose(0, 2, 1, 3).reshape(2, 256, 64),
+                                    v.transpose(0, 2, 1, 3).reshape(2, 256, 64),
+                                    scale, True, 128, 128, True)
+            return (o.astype(jnp.float32) ** 2).sum() + (lse ** 2).sum()
+
+        def loss_dense(q, k, v):
+            qb = q.transpose(0, 2, 1, 3).reshape(2, 256, 64)
+            kb = k.transpose(0, 2, 1, 3).reshape(2, 256, 64)
+            vb = v.transpose(0, 2, 1, 3).reshape(2, 256, 64)
+            s = jnp.einsum("bqd,bkd->bqk", qb, kb) * scale
+            mask = jnp.arange(256)[:, None] >= jnp.arange(256)[None, :]
+            s = jnp.where(mask[None], s, -1e30)
+            lse = jax.nn.logsumexp(s, axis=-1)[..., None]
+            o = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1), vb)
+            return (o ** 2).sum() + (lse ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
+                err_msg=f"d{name} mismatch (dlse path, stream={stream})",
+            )
+
     def test_auto_selection_threshold(self, monkeypatch):
         from pytorch_distributed_example_tpu.ops.flash_attention import (
             _use_streaming,

@@ -356,12 +356,12 @@ class TestContextParallel:
     def test_ring_flash_block_grads_match_dense(self, causal, stream,
                                                 monkeypatch):
         """Flash-block ring gradients are EXACT vs global dense for all
-        of (q, k, v): the lse cotangent from the logaddexp combine folds
-        into the flash backward kernels as `delta - dlse`
-        (`ops.flash_attention.flash_with_lse`), and jax AD handles the
-        cond/fori/ppermute ring around it. `stream=True` forces the
-        STREAMED kernel lowering so the streamed backward's dlse branch
-        is covered too (the 128k-training path's lowering)."""
+        of (q, k, v). The backward is the CUSTOM ring VJP
+        (`context_parallel._ring_core_bwd`): KV shards re-rotate with
+        traveling dk/dv accumulators, and the flash backward kernels
+        run per shard with the ring's FINAL lse/delta. `stream=True`
+        forces the STREAMED kernel lowering (the long-shard training
+        path); resident covers the short-shard case."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
