@@ -61,13 +61,32 @@ def main() -> int:
     if not table:
         print("no flash_sweep_* rows with winners; refusing to bake empty table")
         return 1
-    largest = max(table, key=lambda k: int(k[1:]))
-    table["default"] = dict(table[largest], promoted_from=largest)
+    # Two regimes, two defaults: blocks tuned in the STREAMED lowering
+    # (long sweeps) were never measured under the VMEM-resident kernels
+    # that run at mid-range lengths, so the resident "default" is
+    # promoted only from sweeps <= RESIDENT_MAX_L and the long winner
+    # becomes "default_long", applied from the shortest long sweep up.
+    RESIDENT_MAX_L = 8192
+    lengths = sorted(int(k[1:]) for k in table)
+    resident = [l for l in lengths if l <= RESIDENT_MAX_L]
+    long_ = [l for l in lengths if l > RESIDENT_MAX_L]
+    msg = []
+    if resident:
+        src = f"L{max(resident)}"
+        table["default"] = dict(table[src], promoted_from=src)
+        msg.append(f"default from {src}: {table['default']['block_q']}x"
+                   f"{table['default']['block_k']}")
+    if long_:
+        src = f"L{max(long_)}"
+        table["default_long"] = dict(
+            table[src], promoted_from=src, applies_from=min(long_)
+        )
+        msg.append(f"default_long from {src} (applies from L"
+                   f"{min(long_)}): {table['default_long']['block_q']}x"
+                   f"{table['default_long']['block_k']}")
     with open(OUT, "w") as f:
         json.dump(table, f, indent=2)
-    print(f"baked {len(table) - 1} geometries -> {OUT} "
-          f"(default from {largest}: {table['default']['block_q']}x"
-          f"{table['default']['block_k']})")
+    print(f"baked {len(lengths)} geometries -> {OUT} ({'; '.join(msg)})")
     return 0
 
 

@@ -194,10 +194,12 @@ class Attention(nn.Module):
 
 
 def _flash_ok(L: int, Dh: int) -> bool:
-    # kernel constraint: L divisible by the EFFECTIVE block sizes —
-    # per-call/env overrides (TDX_FLASH_BLOCK_Q/K) included, so an
-    # override that breaks divisibility falls back to dense attention
-    # instead of raising at trace time
+    # kernel constraint: L divisible by the EFFECTIVE block sizes.
+    # resolved_block_sizes FITS env/table candidates (halving, 128
+    # fallback) so they tile L whenever possible; this gate still
+    # catches lengths nothing can tile (e.g. L not a multiple of any
+    # candidate), falling back to dense attention instead of raising
+    # at trace time
     from ..ops.flash_attention import resolved_block_sizes
 
     bq, bk = resolved_block_sizes(L)

@@ -680,23 +680,51 @@ def resolved_block_sizes(
     block_k: Optional[int] = None,
 ) -> tuple:
     """The effective (block_q, block_k) `flash_attention` will use for a
-    given sequence length: per-call override, else `TDX_FLASH_BLOCK_Q` /
-    `TDX_FLASH_BLOCK_K` env, else the hardware-tuned table
-    (`flash_tuned.json`: exact-L entry, then "default"), else 128, each
-    clamped to L. Callers that gate on divisibility (e.g.
-    models.transformer._flash_ok) must check against THESE, not the
-    hard-coded default."""
+    given sequence length: per-call override (clamped to L only — an
+    explicit block that cannot tile L still raises so misconfiguration
+    is loud), else `TDX_FLASH_BLOCK_Q`/`TDX_FLASH_BLOCK_K` env, else
+    the hardware-tuned table (`flash_tuned.json`: exact-L entry, then
+    "default_long" for lengths in the streamed regime it was swept in,
+    then "default"), else 128. Env/table candidates are FITTED: clamped
+    to L and halved (128 fallback) until they tile L, so a default
+    promoted from a long sweep cannot break shorter lengths. Callers
+    that gate on divisibility (e.g. models.transformer._flash_ok) must
+    check against THESE, not the hard-coded default."""
     import os
 
     tuned = _tuned_table()
-    row = tuned.get(f"L{L}") or tuned.get("default") or {}
+    long_row = tuned.get("default_long") or {}
+    row = tuned.get(f"L{L}")
+    if row is None and long_row and L >= int(long_row.get("applies_from",
+                                                          1 << 62)):
+        row = long_row
+    if row is None:
+        row = tuned.get("default") or {}
+
+    def fit(b):
+        # clamp to L, then halve until it tiles; a non-power-of-two
+        # candidate can halve PAST a valid divisor (768 -> 96 misses
+        # 128 at L=1024), so fall back to 128 explicitly
+        b = min(b, L)
+        while b > 128 and L % b:
+            b //= 2
+        if L % b:
+            b = min(128, L)
+        return b
+
     if block_q is None:
         block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 0)) or \
             int(row.get("block_q", 0)) or 128
+        block_q = fit(block_q)
+    else:
+        block_q = min(block_q, L)
     if block_k is None:
         block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 0)) or \
             int(row.get("block_k", 0)) or 128
-    return min(block_q, L), min(block_k, L)
+        block_k = fit(block_k)
+    else:
+        block_k = min(block_k, L)
+    return block_q, block_k
 
 
 def flash_attention(
