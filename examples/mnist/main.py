@@ -224,9 +224,17 @@ def main():
                    help="fuse K full optimizer steps into one compiled "
                         "program (the headline-bench mode; math identical "
                         "to K sequential steps)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the virtual CPU backend — this box's "
+                        "sitecustomize pins the TPU plugin, so the env "
+                        "var alone cannot")
     args = p.parse_args()
 
     import jax
+
+    if args.cpu or __import__("os").environ.get("TDX_EXAMPLES_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 2)
     import jax.numpy as jnp
     import optax
 

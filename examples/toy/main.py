@@ -46,7 +46,17 @@ def main():
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--world-size", type=int, default=-1)
     p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the virtual CPU backend (8 devices) — this "
+                        "box's sitecustomize pins the TPU plugin, so the "
+                        "env var alone cannot")
     args = p.parse_args()
+
+    import os
+    if args.cpu or os.environ.get("TDX_EXAMPLES_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
 
     tdx.init_process_group(
         backend=args.backend,
