@@ -40,9 +40,22 @@ def main():
     ap.add_argument("--train-size", type=int, default=4096)
     ap.add_argument("--test-size", type=int, default=1024)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU backend")
     args = ap.parse_args()
 
+    import os
+
     import jax
+
+    if getattr(args, "cpu", False) or os.environ.get("TDX_EXAMPLES_CPU"):
+        # this box's sitecustomize pins the TPU plugin; env alone cannot
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update(
+            "jax_num_cpu_devices",
+            int(os.environ.get("TDX_EXAMPLES_CPU_DEVICES", "2")),
+        )
+
     import jax.numpy as jnp
     import optax
 

@@ -31,9 +31,22 @@ def main():
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--data", type=str, default=None, help="text file (bytes)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU backend")
     args = ap.parse_args()
 
+    import os
+
     import jax
+
+    if getattr(args, "cpu", False) or os.environ.get("TDX_EXAMPLES_CPU"):
+        # this box's sitecustomize pins the TPU plugin; env alone cannot
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update(
+            "jax_num_cpu_devices",
+            int(os.environ.get("TDX_EXAMPLES_CPU_DEVICES", "2")),
+        )
+
     import jax.numpy as jnp
     import optax
 

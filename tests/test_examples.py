@@ -33,6 +33,28 @@ class TestExampleScripts:
         assert r.returncode == 0, r.stderr[-800:]
         assert "every rank agrees: True" in r.stdout
 
+    def test_cifar_resnet_ddp(self):
+        r = self._run(
+            "examples/cifar/main.py", "--epochs", "1",
+            "--batch-size", "16", "--train-size", "64",
+            "--test-size", "32",
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+
+    def test_lm_tensor_parallel(self):
+        r = self._run(
+            "examples/lm/main.py", "--steps", "4", "--batch-size", "4",
+            "--seq", "64", "--tp", "2", "--log-every", "2",
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+
+    def test_generate_kv_cache(self):
+        r = self._run(
+            "examples/generate/main.py", "--steps", "4", "--new", "8",
+            "--seq", "64",
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+
     @pytest.mark.parametrize("steps_per_call", ["1", "4"])
     def test_mnist_trainer_fused_and_single(self, steps_per_call):
         """One epoch of the MNIST example, per-step and fused modes —
