@@ -94,7 +94,9 @@ from .types import (  # noqa: F401
     DistError,
     DistNetworkError,
     DistStoreError,
+    DistTimeoutError,
 )
+from . import faults  # noqa: F401  (deterministic fault injection)
 from .store import (  # noqa: F401  (torch exposes the store family here)
     FileStore,
     HashStore,

@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume, with integrity guarantees.
 
 Parity surface (SURVEY.md §5.4): the reference has no checkpointing; its
 stack ships `torch/distributed/checkpoint/` (sharded save/load, untouched
@@ -12,26 +12,249 @@ by the example). Minimal-parity behavior implemented here:
     save/load (per-host shard files à la orbax/torch-dcp) is NOT implemented
     yet — on multi-host deployments gather to host 0 before saving.
 
-Format: a directory with `meta.json` (step, tree structure) and `arrays.npz`
-(flattened leaves) — dependency-free, byte-stable, loadable without jax.
+Format: a directory with `meta.json` (step, tree structure), `arrays.npz`
+(flattened leaves) and `manifest.json` (per-file CRC32 + size) —
+dependency-free, byte-stable, loadable without jax.
+
+Integrity contract (this file's robustness layer):
+
+  * **Atomic writes** — every save lands in `<path>.tmp.<pid>`, is fsynced,
+    and is renamed into place last; a mid-write kill leaves either the old
+    checkpoint or an ignorable tmp dir, never a half-written loadable one.
+  * **CRC manifest** — `manifest.json` records crc32+size of every payload
+    file; `load_checkpoint` verifies before deserializing anything.
+  * **Last-good fallback** — the atomic swap keeps the previously-live
+    checkpoint at `<path>.prev`; when the live one fails verification it is
+    quarantined to `<path>.quarantine.<n>` and the load falls back to the
+    last-good copy (warning, not crash). No valid candidate raises
+    `CheckpointCorruptError`.
+
+Fault points: `checkpoint.write` (before any bytes), `checkpoint.finalize`
+(after the tmp dir is complete, before the rename) — a `crash` action at
+either models a mid-write kill.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import sys
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import faults
+from .types import DistError
+
+MANIFEST = "manifest.json"
+_PAYLOAD_FILES = ("meta.json", "arrays.npz")
+
+
+class CheckpointCorruptError(DistError):
+    """No loadable checkpoint: the live copy failed CRC verification and
+    no last-good fallback exists (or it is corrupt too)."""
+
+
+# ---------------------------------------------------------------------------
+# Tree flattening. When jax is loaded its tree_util is authoritative; a
+# process that never imported jax (chaos-test workers, restore tooling)
+# cannot be holding jax arrays, so plain containers flatten through the
+# pure-python fallback below — same path strings, no 2s jax import.
+# ---------------------------------------------------------------------------
+
+
+def _jax_loaded() -> bool:
+    return "jax" in sys.modules
+
+
+def _py_flatten(tree, prefix: Tuple[str, ...] = ()) -> List[Tuple[str, Any]]:
+    # path strings match the jax flattener byte-for-byte: str(DictKey(k))
+    # is f"[{k!r}]" (string 'w' -> "['w']", int 1 -> "[1]"),
+    # str(SequenceKey(i)) is "[i]", str(GetAttrKey(f)) is ".f"
+    # (namedtuples), entries joined by "/"; None is an empty subtree
+    # (jax registers NoneType as a zero-leaf container)
+    if tree is None:
+        return []
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):  # jax sorts dict keys the same way
+            out.extend(_py_flatten(tree[k], prefix + (f"[{k!r}]",)))
+        return out
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
+        out = []
+        for f, v in zip(tree._fields, tree):
+            out.extend(_py_flatten(v, prefix + (f".{f}",)))
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for i, v in enumerate(tree):
+            out.extend(_py_flatten(v, prefix + (f"[{i}]",)))
+        return out
+    return [("/".join(prefix), tree)]
+
+
+def _py_unflatten(template, leaves: List[Any]):
+    it = iter(leaves)
+
+    def rebuild(t):
+        if t is None:
+            return None  # empty subtree: consumes no leaf
+        if isinstance(t, dict):
+            return {k: rebuild(t[k]) for k in sorted(t)}
+        if isinstance(t, tuple) and hasattr(t, "_fields"):
+            return type(t)(*(rebuild(v) for v in t))  # namedtuple ctor
+        if isinstance(t, (list, tuple)):
+            return type(t)(rebuild(v) for v in t)
+        return next(it)
+
+    return rebuild(template)
+
 
 def _flatten_with_paths(tree):
-    import jax
+    if _jax_loaded():
+        import jax
 
-    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    paths = ["/".join(str(k) for k in path) for path, _ in flat]
-    leaves = [leaf for _, leaf in flat]
-    return paths, leaves, treedef
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        paths = ["/".join(str(k) for k in path) for path, _ in flat]
+        leaves = [leaf for _, leaf in flat]
+        return paths, leaves, treedef
+    flat = _py_flatten(tree)
+    return [p for p, _ in flat], [v for _, v in flat], None
+
+
+def _to_host(leaf) -> np.ndarray:
+    if _jax_loaded():
+        import jax
+
+        return np.asarray(jax.device_get(leaf))
+    return np.asarray(leaf)
+
+
+def _unflatten(treedef, template, leaves):
+    if treedef is not None:
+        import jax
+
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    return _py_unflatten(template, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Integrity primitives
+# ---------------------------------------------------------------------------
+
+
+def _crc32_file(path: str) -> Tuple[int, int]:
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync
+
+
+def write_manifest(path: str, extra: Optional[Dict[str, Any]] = None) -> str:
+    """Record crc32+size of every payload file under `path` (recursive —
+    covers both this module's flat layout and orbax's nested one) in
+    `path`/manifest.json."""
+    files = {}
+    for root, dirs, names in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if not d.startswith("."))
+        for name in sorted(names):
+            rel = os.path.relpath(os.path.join(root, name), path)
+            if rel == MANIFEST or name.startswith("."):
+                continue
+            crc, size = _crc32_file(os.path.join(root, name))
+            files[rel] = {"crc32": crc, "size": size}
+    doc = {"version": 1, "files": files}
+    if extra:
+        doc.update(extra)
+    mpath = os.path.join(path, MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    return mpath
+
+
+def verify_checkpoint(
+    path: str, require: Tuple[str, ...] = ()
+) -> Tuple[bool, str]:
+    """(ok, detail). A directory with no manifest is reported ok with
+    detail "no manifest" — pre-integrity checkpoints stay loadable —
+    but any manifest present must verify exactly. `require` names files
+    that must exist even without a manifest (rejects a write that died
+    before its manifest landed)."""
+    if not os.path.isdir(path):
+        return False, "not a directory"
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.exists(mpath):
+        if not all(os.path.exists(os.path.join(path, n)) for n in require):
+            return False, "incomplete checkpoint (missing payload files)"
+        return True, "no manifest"
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable manifest: {e}"
+    for name, rec in (doc.get("files") or {}).items():
+        full = os.path.join(path, name)
+        if not os.path.exists(full):
+            return False, f"missing file {name}"
+        try:
+            crc, size = _crc32_file(full)
+        except OSError as e:
+            # another process quarantined/renamed this checkpoint while
+            # we were reading it: report unverifiable, never crash
+            return False, f"{name}: vanished during verify ({e})"
+        if size != rec.get("size"):
+            return False, f"{name}: size {size} != manifest {rec.get('size')}"
+        if crc != rec.get("crc32"):
+            return (
+                False,
+                f"{name}: crc32 {crc:#010x} != manifest "
+                f"{int(rec.get('crc32', 0)):#010x}",
+            )
+    return True, "ok"
+
+
+def _quarantine(path: str) -> Optional[str]:
+    """Move a corrupt checkpoint aside for forensics (never delete it)."""
+    for n in range(1000):
+        dst = f"{path}.quarantine.{n}"
+        if not os.path.exists(dst):
+            try:
+                os.rename(path, dst)
+                return dst
+            except OSError:
+                return None
+    return None
+
+
+def last_good_path(path: str) -> str:
+    """Where the atomic swap parks the previously-live checkpoint."""
+    return path + ".prev"
+
+
+# ---------------------------------------------------------------------------
+# Save / load
+# ---------------------------------------------------------------------------
 
 
 def save_checkpoint(
@@ -41,17 +264,29 @@ def save_checkpoint(
     step: int = 0,
     extra: Optional[Dict[str, Any]] = None,
 ) -> str:
-    """Rank-0-style host save of (params, opt_state) to a directory."""
-    import jax
+    """Rank-0-style host save of (params, opt_state) to a directory.
 
-    os.makedirs(path, exist_ok=True)
+    Atomic: bytes land in `<path>.tmp.<pid>` (CRC manifest last, fsynced),
+    then one rename swaps it live; the previously-live checkpoint moves to
+    `<path>.prev` and serves as the load-time fallback."""
+    faults.fire("checkpoint.write", path=path, step=step)
     payload = {"params": params}
     if opt_state is not None:
         payload["opt_state"] = opt_state
     paths, leaves, _ = _flatten_with_paths(payload)
-    host = [np.asarray(jax.device_get(l)) for l in leaves]
+    host = [_to_host(l) for l in leaves]
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if os.path.exists(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
     arrays = {f"leaf_{i}": a for i, a in enumerate(host)}
-    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
     meta = {
         "version": 1,
         "step": int(step),
@@ -61,20 +296,39 @@ def save_checkpoint(
         "dtypes": [str(a.dtype) for a in host],
         "shapes": [list(a.shape) for a in host],
     }
-    with open(os.path.join(path, "meta.json"), "w") as f:
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    write_manifest(tmp, extra={"step": int(step)})
+    _fsync_dir(tmp)
+    rule = faults.fire("checkpoint.finalize", path=path, step=step)
+    if rule is not None and rule.action == "corrupt":
+        # injected bit-rot AFTER the manifest: the swap proceeds and the
+        # NEXT load must catch the mismatch by CRC (the advisory action
+        # the docstring promises for checkpoint bit-flips)
+        with open(os.path.join(tmp, "arrays.npz"), "r+b") as f:
+            f.seek(max(os.path.getsize(os.path.join(tmp, "arrays.npz")) // 2,
+                       0))
+            f.write(b"\xde\xad\xbe\xef")
+
+    # swap: live -> .prev (last-good fallback), tmp -> live. A crash
+    # between the renames leaves only .prev — load_checkpoint falls back.
+    prev = last_good_path(path)
+    if os.path.isdir(path):
+        if os.path.isdir(prev):
+            import shutil
+
+            shutil.rmtree(prev)
+        os.rename(path, prev)
+    os.rename(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
     return path
 
 
-def load_checkpoint(
-    path: str, template_params: Any, template_opt_state: Any = None
+def _load_verified(
+    path: str, template_params: Any, template_opt_state: Any
 ) -> Tuple[Any, Any, int, Dict[str, Any]]:
-    """Load into the structure of the given templates; returns
-    (params, opt_state, step, extra). Arrays come back as numpy; pass them
-    through your sharding put (e.g. DDP re-wrap or jit identity) to place
-    them on device."""
-    import jax
-
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     data = np.load(os.path.join(path, "arrays.npz"))
@@ -98,7 +352,64 @@ def load_checkpoint(
             raise ValueError(
                 f"shape mismatch: checkpoint {a.shape} vs template {np.shape(t)}"
             )
-    restored = jax.tree_util.tree_unflatten(treedef, host)
+    restored = _unflatten(treedef, payload, host)
     params = restored["params"]
     opt_state = restored.get("opt_state")
     return params, opt_state, meta["step"], meta.get("extra", {})
+
+
+def load_checkpoint(
+    path: str,
+    template_params: Any,
+    template_opt_state: Any = None,
+    allow_fallback: bool = True,
+) -> Tuple[Any, Any, int, Dict[str, Any]]:
+    """Load into the structure of the given templates; returns
+    (params, opt_state, step, extra). Arrays come back as numpy; pass them
+    through your sharding put (e.g. DDP re-wrap or jit identity) to place
+    them on device.
+
+    Every candidate is CRC-verified before deserialization; a corrupt
+    live checkpoint is quarantined (`<path>.quarantine.<n>`) and, with
+    `allow_fallback` (default), the last-good `<path>.prev` copy is
+    loaded instead. Raises CheckpointCorruptError when nothing verifies,
+    FileNotFoundError when nothing exists."""
+    candidates = [path]
+    if allow_fallback:
+        candidates.append(last_good_path(path))
+    if not any(os.path.isdir(c) for c in candidates):
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    failures = []
+    for i, cand in enumerate(candidates):
+        if not os.path.isdir(cand):
+            continue
+        ok, detail = verify_checkpoint(cand, require=_PAYLOAD_FILES)
+        if not ok and "vanished" in detail:
+            # a concurrent save's atomic swap renamed files under our
+            # read — re-verify the (possibly brand-new) live dir once
+            # before concluding anything
+            ok, detail = verify_checkpoint(cand, require=_PAYLOAD_FILES)
+        if ok:
+            if i > 0:
+                warnings.warn(
+                    f"checkpoint {path} failed integrity verification "
+                    f"({failures[-1][1] if failures else 'missing'}); "
+                    f"loaded last-good fallback {cand}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            return _load_verified(cand, template_params, template_opt_state)
+        failures.append((cand, detail))
+        # never quarantine on a transient verdict (racing writer): only a
+        # checkpoint whose bytes verifiably mismatch is moved aside
+        q = None if "vanished" in detail else _quarantine(cand)
+        warnings.warn(
+            f"corrupt checkpoint {cand}: {detail}"
+            + (f"; quarantined to {q}" if q else ""),
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    raise CheckpointCorruptError(
+        "no loadable checkpoint: "
+        + "; ".join(f"{c}: {d}" for c, d in failures)
+    )

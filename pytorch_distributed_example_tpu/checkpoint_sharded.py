@@ -24,7 +24,15 @@ migrating from `torch.distributed.checkpoint` find the seam.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Optional
+
+from .checkpoint import (
+    CheckpointCorruptError,
+    _quarantine,
+    verify_checkpoint,
+    write_manifest,
+)
 
 __all__ = ["dcp_save", "dcp_async_save", "dcp_load", "DCPCheckpointer"]
 
@@ -55,10 +63,18 @@ def _to_restore_args(template):
 
 def dcp_save(state: Any, path: str, *, force: bool = True) -> str:
     """Write a (possibly sharded) pytree; each process persists only its
-    addressable shards. Returns the checkpoint directory."""
+    addressable shards. Returns the checkpoint directory.
+
+    Process 0 caps the write with a recursive CRC manifest
+    (`manifest.json` — same integrity layer as `checkpoint.py`), so
+    `dcp_load` detects on-disk corruption before orbax deserializes."""
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     ckptr.save(path, state, force=force)
+    import jax
+
+    if jax.process_index() == 0:
+        write_manifest(path)
     return path
 
 
@@ -134,6 +150,15 @@ def dcp_load(template: Any, path: str) -> Any:
     for a memory-light template.
     """
     path = os.path.abspath(path)
+    # EVERY process verifies (shared storage => identical verdict): all
+    # raise CheckpointCorruptError together on corruption. Verifying on
+    # one process only would read the tree once instead of N times, but
+    # with no comms channel here its raise would strand the peers inside
+    # orbax's collective restore until the runtime's barrier timeout —
+    # a wedge is worse than redundant reads.
+    ok, detail = verify_checkpoint(path)
+    if not ok:
+        raise CheckpointCorruptError(f"sharded checkpoint {path}: {detail}")
     ckptr = _checkpointer()
     return ckptr.restore(path, item=template, restore_args=_to_restore_args(template))
 
@@ -150,28 +175,94 @@ class DCPCheckpointer:
             max_to_keep=max_to_keep, create=True
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._unsealed: list = []  # steps saved but not yet manifested
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _seal(self, step: int) -> None:
+        """CRC-manifest a finished step write (process 0 only)."""
+        import jax
+
+        if jax.process_index() == 0 and os.path.isdir(self._step_dir(step)):
+            write_manifest(self._step_dir(step))
 
     def save(self, step: int, state: Any, wait: bool = True) -> bool:
         """`wait=False` returns after the device->host snapshot and lets
         the write land in the background (join with `wait_until_finished`
-        or the next save/close)."""
+        or the next save/close). The CRC manifest is written once the
+        step is durable — immediately for `wait=True`, at the next
+        `wait_until_finished` otherwise."""
         import orbax.checkpoint as ocp
 
         ok = self._mgr.save(step, args=ocp.args.PyTreeSave(state))
+        self._unsealed.append(step)
         if wait:
-            self._mgr.wait_until_finished()
+            self.wait_until_finished()
         return ok
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()
+        for step in self._unsealed:
+            self._seal(step)
+        self._unsealed = []
+
+    def _quarantine_step(self, step: int) -> Optional[str]:
+        """Move a corrupt step OUT of the manager directory (a renamed
+        entry left inside would confuse orbax's step scan). Process 0
+        only — concurrent renames from every process would race; peers
+        verifying mid-rename see a vanished/missing dir, which reads as
+        the same not-ok verdict, so the fallback step still converges."""
+        import jax
+
+        if jax.process_index() != 0:
+            return None
+        src = self._step_dir(step)
+        base = f"{self.directory}.quarantine.step{step}"
+        for n in range(1000):
+            dst = base if n == 0 else f"{base}.{n}"
+            if not os.path.exists(dst):
+                try:
+                    os.rename(src, dst)
+                    return dst
+                except OSError:
+                    return None
+        return None
 
     def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        """Restore `step` (default: latest). Each candidate step is
+        CRC-verified first; a corrupt one is quarantined and — when the
+        caller asked for "latest" — the next-newest step is tried, so a
+        torn write costs one checkpoint interval, not the job."""
         import orbax.checkpoint as ocp
 
+        fall_back = step is None
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        failures = []
+        while True:
+            ok, detail = verify_checkpoint(self._step_dir(step))
+            if ok:
+                break
+            failures.append((step, detail))
+            # transient verdict (another process already renamed the
+            # step away) => nothing left to quarantine
+            q = None if "vanished" in detail else self._quarantine_step(step)
+            warnings.warn(
+                f"corrupt checkpoint step {step}: {detail}"
+                + (f"; quarantined to {q}" if q else ""),
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            earlier = [s for s in self.all_steps() if s < failures[-1][0]]
+            if not fall_back or not earlier:
+                raise CheckpointCorruptError(
+                    "no loadable checkpoint: "
+                    + "; ".join(f"step {s}: {d}" for s, d in failures)
+                )
+            step = max(earlier)
         if template is None:
             return self._mgr.restore(step)
         return self._mgr.restore(

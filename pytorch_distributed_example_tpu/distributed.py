@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from . import backends as _backends
+from . import faults as _faults
 from .backends.base import Backend as _BackendBase
 from .mesh import DeviceMesh, init_device_mesh
 from .rendezvous import rendezvous as _rendezvous
@@ -59,6 +60,25 @@ class GroupMember:
 
     WORLD: Optional["ProcessGroup"] = None
     NON_GROUP_MEMBER = object()
+
+
+def _poison_nan(out):
+    """Injected payload corruption (fault action "corrupt"): every
+    floating leaf of a collective's result becomes NaN, modeling a
+    corrupted wire payload. The multiply (not a fill) preserves dtype,
+    sharding, and laziness; integer/bool leaves pass through untouched.
+    TDX_NAN_CHECK=1's debug audit then catches it exactly as it would a
+    real corruption."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+            return x * jnp.asarray(float("nan"), dt)
+        return x
+
+    return jax.tree_util.tree_map(one, out)
 
 
 class _DispatchMarker:
@@ -166,6 +186,11 @@ class ProcessGroup:
             marker = _DispatchMarker()
             self.watchdog.register(marker, f"{self.group_name}:{op_name}:{seq}")
         try:
+            # fault injection INSIDE watchdog coverage: an injected
+            # "hang" shows up exactly like a real wedged dispatch (the
+            # marker never completes, the watchdog dumps + aborts), and
+            # an injected raise takes the failure bookkeeping below
+            rule = _faults.fire("collective.dispatch", op=op_name, seq=seq)
             out, work = fn()
         except Exception:
             # a raised collective is a failure, not a hang: mark it so the
@@ -174,6 +199,8 @@ class ProcessGroup:
                 marker.abandon()
             rec.complete(seq, self.group_name, failed=True)
             raise
+        if rule is not None and rule.action == "corrupt":
+            out = _poison_nan(out)
         if marker is not None:
             marker.bind(work)
 

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from .. import faults
 from ..store import TCPStore
 
 
@@ -632,6 +633,14 @@ class LocalElasticAgent:
 
     def _heartbeat(self, ctrl) -> None:
         if getattr(self, "_aborted", False):
+            return
+        try:
+            # "agent.heartbeat" fault point, node-targeted via rank=:
+            # any injected raise (reset/drop) is a MISSED beat — peers
+            # then see this node as stale, exactly like a real loss;
+            # "delay" makes beats late; "crash" kills the agent outright
+            faults.fire("agent.heartbeat", rank=self.spec.node_rank)
+        except Exception:
             return
         val = str(time.time())
         if self._standby is not None and self._advertise is not None:

@@ -173,9 +173,20 @@ def _use_streaming(L: int, D: int, itemsize: int = 2) -> bool:
     import os
 
     env = os.environ.get("TDX_FLASH_STREAM")
-    if env is not None:
-        return env == "1"
-    return L * D > _stream_threshold_elems(itemsize)
+    # strict parse (ADVICE r5 #3): '1'/'0' force on/off, unset or ''
+    # means auto; anything else raises — a typo like 'true' silently
+    # forcing OFF would re-enable VMEM-resident kernels at lengths
+    # that OOM (L=16k, D=128)
+    if env in (None, ""):
+        return L * D > _stream_threshold_elems(itemsize)
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    raise ValueError(
+        f"TDX_FLASH_STREAM={env!r} is invalid: use '1' (force streamed), "
+        "'0' (force resident), or unset/'' (auto by operand size)"
+    )
 
 
 def _fwd_kernel_streamed(
@@ -674,6 +685,9 @@ def _tuned_table() -> dict:
         return {}
 
 
+_env_fit_warned: set = set()  # (env_name, requested, L, fitted) already warned
+
+
 def resolved_block_sizes(
     L: int,
     block_q: Optional[int] = None,
@@ -712,16 +726,36 @@ def resolved_block_sizes(
             b = min(128, L)
         return b
 
+    def fit_env(b, env_name, from_env):
+        fitted = fit(b)
+        # warn (once per distinct alteration) when fit() changes an
+        # ENV-provided block: per-call overrides raise loudly on a
+        # non-tiling block, but a fleet-wide env misconfiguration would
+        # otherwise run with a silently different size (ADVICE r5 #5)
+        if from_env and fitted != b:
+            key = (env_name, b, L, fitted)
+            if key not in _env_fit_warned:
+                _env_fit_warned.add(key)
+                import warnings
+
+                warnings.warn(
+                    f"{env_name}={b} cannot tile L={L}; using {fitted} "
+                    "instead — audit the fleet-wide env setting",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+        return fitted
+
     if block_q is None:
-        block_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 0)) or \
-            int(row.get("block_q", 0)) or 128
-        block_q = fit(block_q)
+        env_q = int(os.environ.get("TDX_FLASH_BLOCK_Q", 0))
+        block_q = env_q or int(row.get("block_q", 0)) or 128
+        block_q = fit_env(block_q, "TDX_FLASH_BLOCK_Q", bool(env_q))
     else:
         block_q = min(block_q, L)
     if block_k is None:
-        block_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 0)) or \
-            int(row.get("block_k", 0)) or 128
-        block_k = fit(block_k)
+        env_k = int(os.environ.get("TDX_FLASH_BLOCK_K", 0))
+        block_k = env_k or int(row.get("block_k", 0)) or 128
+        block_k = fit_env(block_k, "TDX_FLASH_BLOCK_K", bool(env_k))
     else:
         block_k = min(block_k, L)
     return block_q, block_k

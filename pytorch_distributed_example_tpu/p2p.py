@@ -51,6 +51,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import faults
+from .utils.retry import RetryPolicy, call_with_retry
+
 _HELLO = struct.Struct("<I")
 # frame header: route_len, tag, seq, kind(0=nd 1=pkl), ndim, dtype_len,
 # payload_len; then route/dtype bytes and `<q` dims follow
@@ -247,15 +250,31 @@ class P2PPlane:
             return self._out_locks.setdefault(dst, threading.Lock())
 
     def _connect_locked(self, dst: int, ep: Tuple[str, int], timeout: float) -> socket.socket:
-        """Cached-or-new connection to dst. Caller holds dst's peer lock."""
+        """Cached-or-new connection to dst. Caller holds dst's peer lock.
+
+        The INITIAL dial retries with backoff (a peer that just published
+        its endpoint may not be accepting yet — previously a single
+        refused connect failed the whole send); once a connection exists,
+        a mid-stream failure stays fatal for the pair (see `send`)."""
         s = self._out.get(dst)
         if s is not None:
             return s
-        s = socket.create_connection(ep, timeout=timeout)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if _SOCK_BUF:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
-        s.settimeout(None)
+
+        def dial() -> socket.socket:
+            faults.fire("p2p.connect", dst=dst)
+            c = socket.create_connection(ep, timeout=timeout)
+            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if _SOCK_BUF:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+            c.settimeout(None)
+            return c
+
+        s = call_with_retry(
+            dial,
+            desc=f"p2p connect r{self.rank}->r{dst} {ep[0]}:{ep[1]}",
+            timeout=timeout,
+            policy=RetryPolicy(base_s=0.02, max_s=0.5),
+        )
         s.sendall(_HELLO.pack(self.rank))
         self._out[dst] = s
         return s
@@ -273,6 +292,9 @@ class P2PPlane:
         plane in a new incarnation."""
         if self._closed:
             raise PlaneClosed("p2p plane closed")
+        # slow-peer straggler simulation lands here (action "delay");
+        # "reset"/"error" model a sender-side plane failure
+        faults.fire("p2p.send", dst=dst, route=route, tag=tag, seq=seq)
         ep = self.endpoint_of(dst, timeout)
         if ep is None:
             raise RuntimeError(f"rank {dst} has no p2p listener (store path only)")

@@ -15,9 +15,29 @@ import os
 from typing import Callable, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
 
+from . import faults
 from .store import DEFAULT_PORT, FileStore, Store, TCPStore
+from .utils.retry import call_with_retry
 
 _handlers: Dict[str, Callable] = {}
+
+
+def _join_store(make, rank: int, url_desc: str, timeout: float) -> Store:
+    """Construct the rendezvous store behind the shared retry policy.
+
+    The `rendezvous.join` fault point fires per attempt (rank-aware), so
+    a plan like {"point": "rendezvous.join", "rank": 1, "action":
+    "reset", "times": 2} exercises two transient join failures that the
+    backoff absorbs, while action "drop"/"error" models a join that
+    fails this worker outright (the elastic agent's restart business)."""
+
+    def attempt():
+        faults.fire("rendezvous.join", rank=rank, url=url_desc)
+        return make()
+
+    return call_with_retry(
+        attempt, desc=f"rendezvous {url_desc}", timeout=timeout
+    )
 
 
 class RendezvousError(RuntimeError):
@@ -54,7 +74,12 @@ def _tcp_handler(url: str, rank: int, world_size: int, timeout: float = 300.0, *
         raise RendezvousError("tcp:// rendezvous needs valid rank and world_size")
     host = parsed.hostname or "127.0.0.1"
     port = parsed.port or DEFAULT_PORT
-    store = TCPStore(host, port, world_size, is_master=(rank == 0), timeout=timeout)
+    store = _join_store(
+        lambda: TCPStore(
+            host, port, world_size, is_master=(rank == 0), timeout=timeout
+        ),
+        rank, f"tcp://{host}:{port}", timeout,
+    )
     yield (store, rank, world_size)
 
 
@@ -80,7 +105,12 @@ def _env_handler(url: str, rank: int, world_size: int, timeout: float = 300.0, *
         os.environ.get("TORCHELASTIC_USE_AGENT_STORE", "").lower() == "true"
     )
     is_master = rank == 0 and not use_agent_store
-    store = TCPStore(host, port, world_size, is_master=is_master, timeout=timeout)
+    store = _join_store(
+        lambda: TCPStore(
+            host, port, world_size, is_master=is_master, timeout=timeout
+        ),
+        rank, f"env://{host}:{port}", timeout,
+    )
     yield (store, rank, world_size)
 
 
@@ -90,7 +120,10 @@ def _file_handler(url: str, rank: int, world_size: int, timeout: float = 300.0, 
     if rank < 0 or world_size < 1:
         raise RendezvousError("file:// rendezvous needs valid rank and world_size")
     path = parsed.path or parsed.netloc
-    store = FileStore(path, world_size, timeout=timeout)
+    store = _join_store(
+        lambda: FileStore(path, world_size, timeout=timeout),
+        rank, f"file://{path}", timeout,
+    )
     yield (store, rank, world_size)
 
 

@@ -23,14 +23,18 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .types import DistStoreError
+from . import faults
+from .types import DistStoreError, DistTimeoutError
+from .utils.retry import RetryPolicy, call_with_retry
 
 DEFAULT_PORT = 29500  # torch TCPStore.hpp:87
 _DEFAULT_TIMEOUT = 300.0
 
 
-class StoreTimeoutError(DistStoreError, TimeoutError):
-    pass
+class StoreTimeoutError(DistStoreError, DistTimeoutError):
+    """Store deadline expiry. Subclasses DistTimeoutError (fatal in the
+    retry taxonomy — utils/retry.py never retries one) and, through it,
+    TimeoutError, preserving existing `except TimeoutError` sites."""
 
 
 class Store:
@@ -56,6 +60,7 @@ class Store:
         raise NotImplementedError
 
     def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        faults.fire("store.wait", keys=keys)
         deadline = time.monotonic() + (timeout if timeout is not None else self.timeout)
         while not self.check(keys):
             if time.monotonic() > deadline:
@@ -142,6 +147,7 @@ class HashStore(Store):
             return all(k in self._data for k in keys)
 
     def wait(self, keys, timeout=None):
+        faults.fire("store.wait", keys=keys)
         deadline = time.monotonic() + (timeout if timeout is not None else self.timeout)
         with self._cv:
             while not all(k in self._data for k in keys):
@@ -338,6 +344,23 @@ _CMD_DELETE = 6
 _CMD_NUMKEYS = 7
 _CMD_PING = 8
 
+# fault-injection point names + retry descriptions per wire command
+_CMD_NAMES = {
+    _CMD_SET: "set",
+    _CMD_GET: "get",
+    _CMD_ADD: "add",
+    _CMD_CHECK: "check",
+    _CMD_COMPARE_SET: "compare_set",
+    _CMD_DELETE: "delete",
+    _CMD_NUMKEYS: "num_keys",
+    _CMD_PING: "ping",
+}
+
+# Connect attempts ramp gently: a worker usually beats the master's bind
+# by milliseconds, so the backoff ceiling stays low (the old loop polled
+# at a flat 50 ms with no jitter — thundering-herd on daemon start).
+_CONNECT_POLICY = RetryPolicy(base_s=0.05, max_s=0.5)
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -472,18 +495,15 @@ class TCPStore(Store):
                 self._daemon.start()
                 port = self._daemon.port
         self.port = port
+        # last successful GET response per key, serving injected
+        # stale-read faults (a replica that lags the primary)
+        self._stale: Dict[str, bytes] = {}
+        self._sock = None
+        self._sock_lock = threading.Lock()
         if self.native:
-            self._native_client = self._lib.tdx_store_client_connect(
-                host.encode(), port, float(timeout)
-            )
-            if not self._native_client:
-                raise StoreTimeoutError(
-                    f"could not connect to store at {host}:{port}"
-                )
-            self._sock = None
+            self._connect_native()
         else:
             self._sock = self._connect()
-        self._sock_lock = threading.Lock()
         # worker-join handshake (torch TCPStore wait_for_workers semantics):
         # every worker registers on connect; the master's constructor blocks
         # until world_size-1 workers have joined.
@@ -498,38 +518,153 @@ class TCPStore(Store):
                     )
                 time.sleep(0.01)
 
-    def _connect(self) -> socket.socket:
-        deadline = time.monotonic() + self.timeout
-        last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
-            try:
-                s = socket.create_connection((self.host, self.port), timeout=self.timeout)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                return s
-            except OSError as e:
-                last_err = e
-                time.sleep(0.05)
-        raise StoreTimeoutError(f"could not connect to store at {self.host}:{self.port}: {last_err}")
+    def _connect_once(self, deadline: Optional[float] = None) -> socket.socket:
+        faults.fire("store.connect", host=self.host, port=self.port)
+        budget = self.timeout
+        if deadline is not None:
+            # a single dial must not outlive the enclosing op deadline
+            # (a SYN-blackholed master blocks inside create_connection
+            # for the whole socket timeout, invisible to the retry
+            # loop's between-attempts deadline checks)
+            budget = max(min(budget, deadline - time.monotonic()), 0.05)
+        s = socket.create_connection((self.host, self.port), timeout=budget)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
 
-    def _call(self, cmd: int, key: str, val: bytes) -> bytes:
+    def _connect(self, deadline: Optional[float] = None) -> socket.socket:
+        eff_deadline = (
+            deadline if deadline is not None
+            else time.monotonic() + self.timeout
+        )
+        try:
+            return call_with_retry(
+                lambda: self._connect_once(eff_deadline),
+                desc=f"store connect {self.host}:{self.port}",
+                deadline=eff_deadline,
+                policy=_CONNECT_POLICY,
+            )
+        except DistTimeoutError as e:
+            raise StoreTimeoutError(
+                f"could not connect to store at {self.host}:{self.port}: "
+                f"{e.__cause__ or e}"
+            ) from e
+
+    def _connect_native(self, deadline: Optional[float] = None) -> None:
+        faults.fire("store.connect", host=self.host, port=self.port)
+        budget = float(self.timeout)
+        if deadline is not None:
+            # honor the enclosing op's deadline: a reconnect mid-op must
+            # not block for a fresh full timeout against a dead master
+            budget = max(min(budget, deadline - time.monotonic()), 0.05)
+        self._native_client = self._lib.tdx_store_client_connect(
+            self.host.encode(), self.port, budget
+        )
+        if not self._native_client:
+            raise StoreTimeoutError(
+                f"could not connect to store at {self.host}:{self.port}"
+            )
+
+    def _drop_connection_locked(self) -> None:
+        """Discard a connection that failed mid-RPC so the next attempt
+        redials. Caller holds `_sock_lock`."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._native_client is not None:
+            try:
+                self._lib.tdx_store_client_close(self._native_client)
+            except Exception:
+                pass
+            self._native_client = None
+
+    def _transport_locked(self, cmd: int, key: str, val: bytes,
+                          deadline: float) -> bytes:
+        """One RPC over the current connection, redialing a dropped one.
+        Caller holds `_sock_lock`; connection-level failures propagate
+        for the retry wrapper in `_call`.
+
+        ADD is the one non-idempotent wire op (the daemon applies the
+        increment before replying): once its request bytes are fully on
+        the wire, a lost RESPONSE is ambiguous — the increment may have
+        been applied — so a blind resend could double-count a barrier or
+        worker-join counter. That ambiguity is surfaced as a fatal
+        DistStoreError instead of being retried; failures before the
+        request is sent (dial, send) stay retryable for every op."""
         kb = key.encode()
         if self.native:
-            with self._sock_lock:
-                n = self._lib.tdx_store_client_call(
-                    self._native_client, cmd, kb, len(kb), val, len(val)
-                )
-                if n < 0:
-                    raise ConnectionError("native store call failed")
-                import ctypes
+            if self._native_client is None:
+                self._connect_native(deadline=deadline)
+            # the native client performs send+recv in one call: treat
+            # any failure of a non-idempotent op as ambiguous
+            n = self._lib.tdx_store_client_call(
+                self._native_client, cmd, kb, len(kb), val, len(val)
+            )
+            if n < 0:
+                if cmd == _CMD_ADD:
+                    self._drop_connection_locked()
+                    raise DistStoreError(
+                        f"store add({key!r}) failed after the request may "
+                        "have been applied; not retrying a non-idempotent op"
+                    )
+                raise ConnectionError("native store call failed")
+            import ctypes
 
-                return ctypes.string_at(
-                    self._lib.tdx_store_client_response(self._native_client), n
-                )
+            return ctypes.string_at(
+                self._lib.tdx_store_client_response(self._native_client), n
+            )
+        if self._sock is None:
+            self._sock = self._connect(deadline=deadline)
         msg = bytes([cmd]) + struct.pack("<I", len(kb)) + kb + struct.pack("<I", len(val)) + val
-        with self._sock_lock:
-            self._sock.sendall(msg)
+        self._sock.sendall(msg)
+        try:
             n = struct.unpack("<I", _recv_exact(self._sock, 4))[0]
             return _recv_exact(self._sock, n)
+        except (ConnectionError, OSError) as e:
+            if cmd == _CMD_ADD:
+                self._drop_connection_locked()
+                raise DistStoreError(
+                    f"store add({key!r}): connection lost awaiting the "
+                    f"response ({e}); the increment may have been applied — "
+                    "not retrying a non-idempotent op"
+                ) from e
+            raise
+
+    def _call(self, cmd: int, key: str, val: bytes) -> bytes:
+        """One logical store op: fault-injectable, retried with
+        exponential backoff + jitter on transient connection failures,
+        failing fast with a StoreTimeoutError/DistTimeoutError once the
+        op deadline (self.timeout) is spent. The deadline is shared by
+        every attempt AND any nested reconnect, so retries never
+        compound the budget."""
+        op = _CMD_NAMES.get(cmd, f"cmd{cmd}")
+        point = f"store.{op}"
+        deadline = time.monotonic() + self.timeout
+
+        def attempt() -> bytes:
+            rule = faults.fire(point, key=key)
+            if rule is not None and rule.action == "stale" and cmd == _CMD_GET:
+                # stale replica read: the last response THIS client saw
+                # for the key, or a miss if it never saw one
+                return self._stale.get(key, b"\x00")
+            with self._sock_lock:
+                try:
+                    resp = self._transport_locked(cmd, key, val, deadline)
+                except (ConnectionError, OSError):
+                    self._drop_connection_locked()
+                    raise
+            # cache last GET responses ONLY while a fault plan is active
+            # (stale-read faults need them) — an always-on cache would
+            # grow by one entry per distinct key for the client lifetime
+            if cmd == _CMD_GET and resp[:1] == b"\x01" and faults.enabled():
+                self._stale[key] = resp
+            return resp
+
+        return call_with_retry(
+            attempt, desc=f"store {op}({key!r})", deadline=deadline
+        )
 
     def set(self, key, value):
         self._call(_CMD_SET, key, _to_bytes(value))

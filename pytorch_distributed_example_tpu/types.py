@@ -36,7 +36,16 @@ class DistStoreError(DistError):
 
 
 class DistNetworkError(DistError):
-    """torch `DistNetworkError` — connection-level failures."""
+    """torch `DistNetworkError` — connection-level failures. Transient by
+    taxonomy: the shared retry layer (`utils/retry.py`) backs off and
+    retries these while its deadline allows."""
+
+
+class DistTimeoutError(DistError, TimeoutError):
+    """A retry/operation deadline expired. FATAL by taxonomy: the retry
+    layer never retries one (a nested retry scope must not multiply the
+    outer scope's budget), and raises it with the last transient error
+    as `__cause__`."""
 
 
 class ReduceOp(enum.Enum):

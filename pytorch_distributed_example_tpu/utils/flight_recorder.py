@@ -10,6 +10,7 @@ on watchdog trips (`TORCH_NCCL_DUMP_ON_TIMEOUT`). Dump format here is JSON
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
@@ -145,12 +146,28 @@ class DebugInfoWriter:
     """Pluggable dump sink — torch `DebugInfoWriter` (FlightRecorder.hpp:70).
     Default writes `tdx_flight_<pid>.json` into TDX_DEBUG_DIR or cwd."""
 
+    # process-global dump sequence: every writer instance shares it, so
+    # two Watchdogs (world + a subgroup) tripping in one process cannot
+    # both claim the unnumbered first-dump name
+    _dump_seq = itertools.count()
+
     def __init__(self, directory: Optional[str] = None):
         self.directory = directory or os.environ.get("TDX_DEBUG_DIR", ".")
 
     def write(self, recorder: FlightRecorder, reason: str = "") -> str:
+        """First dump in the PROCESS keeps the stable
+        `tdx_flight_<pid>.json` name (tooling contract); later dumps
+        from any writer (double abort, repeated watchdog trips, multiple
+        groups) get a numbered suffix instead of silently overwriting
+        the first one's evidence."""
         os.makedirs(self.directory, exist_ok=True)
-        path = os.path.join(self.directory, f"tdx_flight_{os.getpid()}.json")
+        n = next(DebugInfoWriter._dump_seq)
+        name = (
+            f"tdx_flight_{os.getpid()}.json"
+            if n == 0
+            else f"tdx_flight_{os.getpid()}_{n}.json"
+        )
+        path = os.path.join(self.directory, name)
         payload = recorder.dump()
         payload["reason"] = reason
         with open(path, "w") as f:

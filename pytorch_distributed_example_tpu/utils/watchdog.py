@@ -15,6 +15,7 @@ watchdog then dumps the flight recorder and invokes the abort callback.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -59,21 +60,34 @@ class Watchdog:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Watchdog":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True, name="tdx-watchdog"
-            )
-            self._thread.start()
+        """Idempotent while running; restartable after stop() (including
+        a stop() that timed out on a wedged callback — once that thread
+        dies the next start() replaces it)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()  # fresh: a reused set() event
+        self.last_heartbeat = time.monotonic()  # would kill the new thread
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tdx-watchdog"
+        )
+        self._thread.start()
         return self
 
     def stop(self) -> None:
+        """Signal and join the scanner. A scan wedged inside a timeout
+        callback can outlive the 5s join grace — the thread reference is
+        kept so a still-running scanner is never orphaned into a leak
+        (start() refuses to double-spawn while it lives)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(5.0)
-            self._thread = None
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+            if not t.is_alive():
+                self._thread = None
 
     def _run(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        stop = self._stop
+        while not stop.wait(self.poll_interval_s):
             self.last_heartbeat = time.monotonic()
             self._scan()
 
@@ -92,13 +106,22 @@ class Watchdog:
             self._work = alive
         for t0, desc, w in expired:
             self.tripped = desc
-            path = ""
-            if self.dump_on_timeout:
-                path = self.writer.write(
-                    self.recorder, reason=f"watchdog timeout: {desc}"
+            # a raising dump/abort callback must not kill the scanner:
+            # other in-flight works still need their timeouts observed
+            # (and a double-abort dumps BOTH, to numbered files)
+            try:
+                path = ""
+                if self.dump_on_timeout:
+                    path = self.writer.write(
+                        self.recorder, reason=f"watchdog timeout: {desc}"
+                    )
+                if self.on_timeout is not None:
+                    self.on_timeout(desc, w, path)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "watchdog timeout handler failed for %r "
+                    "(abort/dump did NOT complete)", desc
                 )
-            if self.on_timeout is not None:
-                self.on_timeout(desc, w, path)
 
 
 class HeartbeatMonitor:
@@ -122,18 +145,26 @@ class HeartbeatMonitor:
         self.stuck = False
 
     def start(self) -> "HeartbeatMonitor":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True, name="tdx-heartbeat"
-            )
-            self._thread.start()
+        """Idempotent while running; restartable after a stuck trip (the
+        monitor thread returns once it fires — after the watchdog
+        recovers, `start()` arms a fresh monitor and clears `stuck`)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()
+        self.stuck = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tdx-heartbeat"
+        )
+        self._thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(5.0)
-            self._thread = None
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+            if not t.is_alive():
+                self._thread = None
 
     def _run(self) -> None:
         while not self._stop.wait(min(self.heartbeat_timeout_s / 4, 5.0)):

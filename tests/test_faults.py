@@ -1,0 +1,199 @@
+"""Fault-injection subsystem: plan parsing, trigger counting, rank
+targeting, seeded determinism, env round-trip (spawn survival), and the
+generic action semantics call sites rely on."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pytorch_distributed_example_tpu import faults
+from pytorch_distributed_example_tpu.types import DistError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class TestPlanParsing:
+    def test_single_rule_object_or_list(self):
+        p1 = faults.FaultPlan.parse('{"point": "store.get", "action": "reset"}')
+        p2 = faults.FaultPlan.parse('[{"point": "store.get", "action": "reset"}]')
+        assert len(p1.rules) == len(p2.rules) == 1
+        assert p1.rules[0].point == "store.get"
+
+    def test_bad_json_and_bad_fields_raise(self):
+        with pytest.raises(ValueError, match="not valid JSON"):
+            faults.FaultPlan.parse("{nope")
+        with pytest.raises(ValueError, match="unknown fields"):
+            faults.FaultPlan.parse('{"point": "x", "action": "reset", "bogus": 1}')
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.FaultPlan.parse('{"point": "x", "action": "explode"}')
+        with pytest.raises(ValueError, match="needs 'point'"):
+            faults.FaultPlan.parse('{"action": "reset"}')
+
+    def test_round_trip(self):
+        plan = faults.FaultPlan.parse(
+            '[{"point": "store.*", "action": "delay", "rank": 2, '
+            '"after": 3, "times": -1, "delay_s": 0.5, "restart_lt": 2}]'
+        )
+        again = faults.FaultPlan.parse(plan.to_json())
+        assert again.rules[0].to_dict() == plan.rules[0].to_dict()
+
+
+class TestTriggerCounting:
+    def test_after_and_times(self):
+        faults.install_plan(
+            [{"point": "p", "action": "reset", "after": 2, "times": 2}]
+        )
+        faults.fire("p", rank=0)  # call 1: below `after`
+        with pytest.raises(ConnectionResetError):
+            faults.fire("p", rank=0)  # call 2 fires
+        with pytest.raises(ConnectionResetError):
+            faults.fire("p", rank=0)  # call 3 fires (times=2)
+        assert faults.fire("p", rank=0) is None  # budget spent
+
+    def test_rank_targeting(self):
+        faults.install_plan(
+            [{"point": "p", "action": "reset", "rank": 1}]
+        )
+        assert faults.fire("p", rank=0) is None
+        with pytest.raises(ConnectionResetError):
+            faults.fire("p", rank=1)
+
+    def test_rank_from_env(self, monkeypatch):
+        faults.install_plan([{"point": "p", "action": "reset", "rank": 3}])
+        monkeypatch.setenv("RANK", "3")
+        with pytest.raises(ConnectionResetError):
+            faults.fire("p")
+        monkeypatch.setenv("RANK", "2")
+        assert faults.fire("p") is None
+
+    def test_glob_points(self):
+        faults.install_plan(
+            [{"point": "store.*", "action": "reset", "times": -1}]
+        )
+        with pytest.raises(ConnectionResetError):
+            faults.fire("store.get", rank=0)
+        with pytest.raises(ConnectionResetError):
+            faults.fire("store.check", rank=0)
+        assert faults.fire("p2p.connect", rank=0) is None
+
+    def test_restart_gate(self, monkeypatch):
+        faults.install_plan(
+            [{"point": "p", "action": "reset", "restart_lt": 1, "times": -1}]
+        )
+        monkeypatch.setenv("TDX_RESTART_COUNT", "0")
+        with pytest.raises(ConnectionResetError):
+            faults.fire("p", rank=0)
+        monkeypatch.setenv("TDX_RESTART_COUNT", "1")
+        assert faults.fire("p", rank=0) is None
+
+    def test_seeded_prob_is_deterministic(self):
+        def firing_pattern():
+            plan = faults.FaultPlan.parse(
+                '{"point": "p", "action": "reset", "prob": 0.5, '
+                '"seed": 42, "times": -1}'
+            )
+            faults.install_plan(plan, export_env=False)
+            out = []
+            for _ in range(32):
+                try:
+                    faults.fire("p", rank=0)
+                    out.append(0)
+                except ConnectionResetError:
+                    out.append(1)
+            return out
+
+        a, b = firing_pattern(), firing_pattern()
+        assert a == b
+        assert 0 < sum(a) < 32  # actually probabilistic
+
+
+class TestActions:
+    def test_delay_sleeps(self):
+        faults.install_plan(
+            [{"point": "p", "action": "delay", "delay_s": 0.15}]
+        )
+        t0 = time.monotonic()
+        assert faults.fire("p", rank=0) is None
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_drop_raises_fault_timeout(self):
+        faults.install_plan([{"point": "p", "action": "drop"}])
+        with pytest.raises(faults.FaultTimeout):
+            faults.fire("p", rank=0)
+
+    def test_error_raises_dist_error(self):
+        faults.install_plan(
+            [{"point": "p", "action": "error", "message": "boom"}]
+        )
+        with pytest.raises(DistError, match="boom"):
+            faults.fire("p", rank=0)
+
+    def test_advisory_actions_return_rule(self):
+        faults.install_plan([{"point": "p", "action": "stale"}])
+        rule = faults.fire("p", rank=0)
+        assert rule is not None and rule.action == "stale"
+
+
+class TestSpawnSurvival:
+    def test_install_exports_env_and_child_inherits(self):
+        faults.install_plan(
+            [{"point": "child.op", "action": "error", "message": "from-parent"}]
+        )
+        assert "TDX_FAULT_PLAN" in os.environ
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from pytorch_distributed_example_tpu import faults\n"
+            "try:\n"
+            "    faults.fire('child.op', rank=0)\n"
+            "    print('NOFIRE')\n"
+            "except Exception as e:\n"
+            "    print(type(e).__name__, e)\n" % REPO
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert "DistError from-parent" in r.stdout, (r.stdout, r.stderr)
+
+    def test_clear_plan_removes_env(self):
+        faults.install_plan([{"point": "p", "action": "reset"}])
+        faults.clear_plan()
+        assert "TDX_FAULT_PLAN" not in os.environ
+        assert faults.fire("p", rank=0) is None
+
+
+class TestMalformedPlan:
+    def test_bad_env_plan_raises_on_every_fire(self, monkeypatch):
+        """A JSON typo must fail loudly at EVERY injection point, never
+        silently degrade to no-plan (a chaos test passing vacuously)."""
+        faults.clear_plan()
+        monkeypatch.setenv("TDX_FAULT_PLAN", "{not json")
+        # force a fresh lazy load
+        faults._plan_loaded = False
+        faults._plan = None
+        faults._plan_error = None
+        with pytest.raises(ValueError, match="not valid JSON"):
+            faults.fire("p", rank=0)
+        with pytest.raises(ValueError, match="not valid JSON"):
+            faults.fire("q", rank=1)  # still raising, not swallowed
+        assert faults.enabled()
+
+    def test_enabled_reflects_plan_state(self):
+        assert not faults.enabled()
+        faults.install_plan([{"point": "p", "action": "reset"}])
+        assert faults.enabled()
+        faults.clear_plan()
+        assert not faults.enabled()

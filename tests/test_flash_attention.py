@@ -182,6 +182,53 @@ class TestFlashStreamed:
         monkeypatch.setenv("TDX_FLASH_STREAM", "0")
         assert not _use_streaming(65536, 128)      # explicit override wins
 
+    def test_stream_env_strict_parse(self, monkeypatch):
+        """ADVICE r5 #3: '1'/'0' force, unset/'' auto, junk raises (a
+        typo like 'true' used to silently force the VMEM-resident
+        kernels back on at OOM lengths)."""
+        from pytorch_distributed_example_tpu.ops.flash_attention import (
+            _use_streaming,
+        )
+
+        monkeypatch.setenv("TDX_FLASH_STREAM", "")
+        assert _use_streaming(16384, 128)  # '' = auto, not force-off
+        monkeypatch.setenv("TDX_FLASH_STREAM", "1")
+        assert _use_streaming(128, 16)
+        for junk in ("true", "yes", "2", "on"):
+            monkeypatch.setenv("TDX_FLASH_STREAM", junk)
+            with pytest.raises(ValueError, match="TDX_FLASH_STREAM"):
+                _use_streaming(16384, 128)
+
+    def test_env_block_fit_warns_once(self, monkeypatch):
+        """ADVICE r5 #5: a fleet-wide TDX_FLASH_BLOCK_Q/K that fit()
+        must alter warns (once per distinct alteration) so env
+        misconfigurations stay auditable; per-call overrides never
+        warn."""
+        import importlib
+        import warnings as _warnings
+
+        fa = importlib.import_module(
+            "pytorch_distributed_example_tpu.ops.flash_attention"
+        )
+
+        monkeypatch.setenv("TDX_FLASH_BLOCK_Q", "768")  # cannot tile 1024
+        monkeypatch.delenv("TDX_FLASH_BLOCK_K", raising=False)
+        fa._env_fit_warned.clear()
+        with _warnings.catch_warnings(record=True) as w:
+            _warnings.simplefilter("always")
+            bq, _ = fa.resolved_block_sizes(1024)
+            fa.resolved_block_sizes(1024)  # same alteration: no 2nd warning
+        assert bq == 128
+        hits = [x for x in w if "TDX_FLASH_BLOCK_Q" in str(x.message)]
+        assert len(hits) == 1
+        # a tiling env block stays silent
+        monkeypatch.setenv("TDX_FLASH_BLOCK_Q", "256")
+        with _warnings.catch_warnings(record=True) as w2:
+            _warnings.simplefilter("always")
+            bq2, _ = fa.resolved_block_sizes(1024)
+        assert bq2 == 256
+        assert not [x for x in w2 if "TDX_FLASH_BLOCK" in str(x.message)]
+
 
 class TestFlashWithUlysses:
     def test_flash_as_ulysses_kernel(self):

@@ -1,0 +1,237 @@
+"""Retry policy: backoff growth + jitter bounds, deadline fail-fast with
+the DistError taxonomy, and the store client's retry-under-faults
+behavior the acceptance criteria pin."""
+
+import time
+
+import pytest
+
+from pytorch_distributed_example_tpu import faults
+from pytorch_distributed_example_tpu.store import StoreTimeoutError, TCPStore
+from pytorch_distributed_example_tpu.types import (
+    DistError,
+    DistNetworkError,
+    DistTimeoutError,
+)
+from pytorch_distributed_example_tpu.utils.retry import (
+    RetryPolicy,
+    call_with_retry,
+    is_retryable,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class TestPolicy:
+    def test_backoff_grows_exponentially_and_caps(self):
+        p = RetryPolicy(base_s=0.1, max_s=1.0, multiplier=2.0, jitter=0.0)
+        seq = [p.backoff(a) for a in range(1, 7)]
+        assert seq == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+    def test_jitter_bounds(self):
+        import random
+
+        p = RetryPolicy(base_s=1.0, max_s=1.0, jitter=0.5)
+        rng = random.Random(0)
+        for _ in range(100):
+            s = p.backoff(1, rng)
+            assert 0.5 <= s <= 1.0
+
+    def test_seeded_jitter_deterministic(self):
+        p = RetryPolicy(base_s=0.01, max_s=0.1)
+        sleeps_a, sleeps_b = [], []
+
+        def run(sink):
+            calls = [0]
+
+            def flaky():
+                calls[0] += 1
+                if calls[0] < 4:
+                    raise ConnectionResetError("x")
+                return "ok"
+
+            return call_with_retry(
+                flaky, desc="t", timeout=10.0, policy=p, seed=7,
+                on_retry=lambda a, e, s: sink.append(s),
+            )
+
+        assert run(sleeps_a) == "ok" and run(sleeps_b) == "ok"
+        assert sleeps_a == sleeps_b and len(sleeps_a) == 3
+
+
+class TestTaxonomy:
+    def test_retryable_classification(self):
+        assert is_retryable(ConnectionResetError())
+        assert is_retryable(ConnectionRefusedError())
+        assert is_retryable(OSError())
+        assert is_retryable(DistNetworkError("x"))
+        assert is_retryable(faults.FaultTimeout("x"))
+        assert not is_retryable(DistTimeoutError("deadline"))
+        assert not is_retryable(StoreTimeoutError("deadline"))
+        assert not is_retryable(ValueError("x"))
+        assert not is_retryable(DistError("x"))
+
+    def test_non_retryable_escapes_immediately(self):
+        calls = [0]
+
+        def fatal():
+            calls[0] += 1
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            call_with_retry(fatal, desc="t", timeout=5.0)
+        assert calls[0] == 1
+
+    def test_nested_deadline_fails_fast(self):
+        calls = [0]
+
+        def inner_expired():
+            calls[0] += 1
+            raise DistTimeoutError("inner deadline spent")
+
+        with pytest.raises(DistTimeoutError):
+            call_with_retry(inner_expired, desc="outer", timeout=30.0)
+        assert calls[0] == 1  # no budget-multiplying retries
+
+    def test_deadline_exhaustion_wraps_last_error(self):
+        def always():
+            raise ConnectionResetError("flaky")
+
+        t0 = time.monotonic()
+        with pytest.raises(DistTimeoutError) as ei:
+            call_with_retry(
+                always, desc="t", timeout=0.3,
+                policy=RetryPolicy(base_s=0.01, max_s=0.05),
+            )
+        assert time.monotonic() - t0 < 2.0
+        assert isinstance(ei.value.__cause__, ConnectionResetError)
+
+    def test_attempt_cap_without_deadline(self):
+        calls = [0]
+
+        def always():
+            calls[0] += 1
+            raise ConnectionResetError("x")
+
+        with pytest.raises(DistTimeoutError, match="retry budget"):
+            call_with_retry(
+                always, desc="t",
+                policy=RetryPolicy(base_s=0.001, max_s=0.001, max_attempts=5),
+            )
+        assert calls[0] == 5
+
+
+class TestStoreRetryUnderFaults:
+    """Acceptance: store client ops retry with backoff under injected
+    transient faults; fail fast with a non-retryable DistError past the
+    deadline."""
+
+    def test_transient_resets_recovered(self):
+        faults.install_plan(
+            [{"point": "store.get", "after": 1, "times": 2,
+              "action": "reset"}],
+            export_env=False,
+        )
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0,
+                     use_native=False)
+        try:
+            m.set("k", b"v")
+            assert m.get("k") == b"v"  # two injected resets retried through
+        finally:
+            faults.clear_plan()
+            m.close()
+
+    def test_real_connection_reset_recovered(self):
+        """Not just injected raises: kill the transport underneath the
+        client and let the retry layer redial."""
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0,
+                     use_native=False)
+        c = TCPStore("127.0.0.1", m.port, timeout=5.0, use_native=False)
+        try:
+            m.set("k", b"v")
+            assert c.get("k") == b"v"
+            c._sock.close()  # connection dies under the client
+            assert c.get("k") == b"v"  # redialed transparently
+        finally:
+            c.close()
+            m.close()
+
+    def test_permanent_fault_fails_fast_past_deadline(self):
+        faults.install_plan(
+            [{"point": "store.get", "action": "reset", "times": -1}],
+            export_env=False,
+        )
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0,
+                     use_native=False)
+        c = TCPStore("127.0.0.1", m.port, timeout=0.5, use_native=False)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DistTimeoutError) as ei:
+                c.get("k")
+            took = time.monotonic() - t0
+            assert took < 5.0  # bounded by c.timeout, not m's
+            assert not is_retryable(ei.value)
+        finally:
+            faults.clear_plan()
+            c.close()
+            m.close()
+
+    def test_add_is_not_retried_after_response_loss(self, monkeypatch):
+        """ADD is non-idempotent (the daemon applies the increment before
+        replying): a connection lost while awaiting the RESPONSE must
+        fail the op, not resend it — a blind retry could double-count a
+        barrier/worker-join counter."""
+        import pytorch_distributed_example_tpu.store as store_mod
+        from pytorch_distributed_example_tpu.types import DistStoreError
+
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0,
+                     use_native=False)
+        try:
+            assert m.add("ctr", 1) == 1
+            real = store_mod._recv_exact
+            state = {"armed": True}
+
+            def lossy(sock, n):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise ConnectionResetError("response lost")
+                return real(sock, n)
+
+            monkeypatch.setattr(store_mod, "_recv_exact", lossy)
+            with pytest.raises(DistStoreError, match="non-idempotent"):
+                m.add("ctr", 1)
+            monkeypatch.setattr(store_mod, "_recv_exact", real)
+            # the daemon DID apply the ambiguous increment; the caller
+            # decides how to reconcile — the client must not have also
+            # resent it (counter would read 4)
+            assert m.add("ctr", 1) == 3
+        finally:
+            m.close()
+
+    def test_stale_cache_only_populated_under_a_plan(self):
+        m = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0,
+                     use_native=False)
+        try:
+            m.set("k", b"v")
+            assert m.get("k") == b"v"
+            assert m._stale == {}  # no plan: no cache growth
+            faults.install_plan(
+                [{"point": "never.fires", "action": "reset"}],
+                export_env=False,
+            )
+            assert m.get("k") == b"v"
+            assert "k" in m._stale
+        finally:
+            faults.clear_plan()
+            m.close()
+
+    def test_connect_fails_fast_to_dead_host(self):
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeoutError):
+            TCPStore("127.0.0.1", 1, timeout=0.5, use_native=False)
+        assert time.monotonic() - t0 < 5.0

@@ -1,0 +1,143 @@
+"""Watchdog / HeartbeatMonitor edge cases (ISSUE 1 satellite): timeout
+racing stop(), monitor restart after recovery, and dump behavior on a
+double abort."""
+
+import threading
+import time
+
+from pytorch_distributed_example_tpu.utils.flight_recorder import (
+    DebugInfoWriter,
+    FlightRecorder,
+)
+from pytorch_distributed_example_tpu.utils.watchdog import (
+    HeartbeatMonitor,
+    Watchdog,
+)
+
+
+class _NeverDone:
+    def is_completed(self):
+        return False
+
+
+class _Done:
+    def is_completed(self):
+        return True
+
+
+def _watchdog(tmp_path, **kw):
+    kw.setdefault("timeout_s", 0.05)
+    kw.setdefault("poll_interval_s", 0.01)
+    kw.setdefault("recorder", FlightRecorder(capacity=8))
+    kw.setdefault("writer", DebugInfoWriter(str(tmp_path)))
+    return Watchdog(**kw)
+
+
+class TestWatchdogStop:
+    def test_timeout_during_stop_does_not_wedge_or_leak(self, tmp_path):
+        """A timeout callback still running while stop() joins: stop()
+        returns within its grace, keeps the thread reference (no orphan),
+        and a later start() resumes scanning once the old thread dies."""
+        release = threading.Event()
+        fired = threading.Event()
+
+        def slow_abort(desc, work, path):
+            fired.set()
+            release.wait(10.0)
+
+        wd = _watchdog(tmp_path, on_timeout=slow_abort).start()
+        wd.register(_NeverDone(), "wedged")
+        assert fired.wait(5.0)
+        t0 = time.monotonic()
+        wd.stop()  # callback still blocked in release.wait
+        assert time.monotonic() - t0 < 8.0
+        assert wd._thread is not None  # wedged scanner not orphaned
+        release.set()
+        wd._thread.join(5.0)
+        wd.stop()  # now reaps cleanly
+        assert wd._thread is None
+
+    def test_stop_start_cycle_scans_again(self, tmp_path):
+        trips = []
+        wd = _watchdog(
+            tmp_path, on_timeout=lambda d, w, p: trips.append(d),
+            dump_on_timeout=False,
+        ).start()
+        wd.register(_NeverDone(), "first")
+        deadline = time.monotonic() + 5.0
+        while not trips and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert trips
+        wd.stop()
+        wd.start()  # restart after a full stop
+        wd.register(_NeverDone(), "second")
+        deadline = time.monotonic() + 5.0
+        while len(trips) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert any(d == "second" for d in trips)
+
+    def test_raising_callback_does_not_kill_scanner(self, tmp_path):
+        seen = []
+
+        def bad_then_record(desc, work, path):
+            seen.append(desc)
+            raise RuntimeError("abort handler exploded")
+
+        wd = _watchdog(
+            tmp_path, on_timeout=bad_then_record, dump_on_timeout=False
+        ).start()
+        wd.register(_NeverDone(), "a")
+        deadline = time.monotonic() + 5.0
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.register(_NeverDone(), "b")
+        deadline = time.monotonic() + 5.0
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert {"a", "b"} <= set(seen)  # scanner survived the first raise
+
+
+class TestDoubleAbortDump:
+    def test_two_timeouts_dump_two_files(self, tmp_path):
+        wd = _watchdog(tmp_path).start()
+        wd.register(_NeverDone(), "abort-1")
+        wd.register(_NeverDone(), "abort-2")
+        deadline = time.monotonic() + 5.0
+        while (
+            len(list(tmp_path.glob("tdx_flight_*.json"))) < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        wd.stop()
+        dumps = sorted(tmp_path.glob("tdx_flight_*.json"))
+        assert len(dumps) >= 2  # second dump did not overwrite the first
+
+
+class TestHeartbeatMonitorRestart:
+    def test_restart_after_recovery(self, tmp_path):
+        """Monitor trips on a wedged watchdog, fires, and returns; after
+        the watchdog recovers, start() re-arms a fresh monitor."""
+        wd = _watchdog(tmp_path)  # NOT started: heartbeat goes stale
+        wd.last_heartbeat = time.monotonic() - 100.0
+        stuck_events = []
+        hb = HeartbeatMonitor(
+            wd, heartbeat_timeout_s=0.05, kill_process=False,
+            on_stuck=lambda age: stuck_events.append(age),
+        ).start()
+        deadline = time.monotonic() + 5.0
+        while not hb.stuck and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hb.stuck and stuck_events
+        hb._thread.join(5.0)  # monitor thread exits after firing
+        # recovery: watchdog beats again; a restarted monitor stays calm
+        wd.start()
+        time.sleep(0.05)
+        hb.start()
+        assert hb.stuck is False  # cleared on re-arm
+        time.sleep(0.2)
+        assert hb.stuck is False  # fresh beats keep it calm
+        hb.stop()
+        wd.stop()
+        assert len(stuck_events) == 1
