@@ -50,9 +50,19 @@ def main():
                    help="force the virtual CPU backend (8 devices) — this "
                         "box's sitecustomize pins the TPU plugin, so the "
                         "env var alone cannot")
+    p.add_argument("--schedule-check", action="store_true",
+                   help="arm the cross-rank collective-schedule verifier "
+                        "(TDX_SCHEDULE_CHECK=1): every collective is "
+                        "fingerprinted and divergent schedules raise a "
+                        "diagnostic naming the offending op instead of "
+                        "hanging")
     args = p.parse_args()
 
     import os
+    if args.schedule_check:
+        # must be set before init_process_group: the verifier is armed at
+        # group creation
+        os.environ["TDX_SCHEDULE_CHECK"] = "1"
     if args.cpu or os.environ.get("TDX_EXAMPLES_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -97,6 +97,7 @@ from .types import (  # noqa: F401
     DistTimeoutError,
 )
 from . import faults  # noqa: F401  (deterministic fault injection)
+from .schedule import ScheduleMismatchError  # noqa: F401  (TDX_SCHEDULE_CHECK)
 from .store import (  # noqa: F401  (torch exposes the store family here)
     FileStore,
     HashStore,

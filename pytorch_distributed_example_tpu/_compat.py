@@ -2,14 +2,44 @@
 
 from __future__ import annotations
 
+import inspect
+from typing import Optional
+
+_sm = None
+_check_kw: Optional[str] = None
+
+
+def _resolve_shard_map():
+    """Locate shard_map and the name of its replication-check kwarg.
+
+    jax moved shard_map from `jax.experimental` to `jax.shard_map` and
+    renamed `check_rep` to `check_vma` along the way; passing the wrong
+    one is a TypeError that kills every compiled collective. Resolved
+    once by signature introspection, not version parsing.
+    """
+    global _sm, _check_kw
+    if _sm is None:
+        import jax
+
+        sm = getattr(jax, "shard_map", None)
+        if sm is None:
+            from jax.experimental.shard_map import shard_map as sm  # type: ignore
+        try:
+            params = set(inspect.signature(sm).parameters)
+        except (TypeError, ValueError):
+            params = {"check_vma"}
+        if "check_vma" in params:
+            _check_kw = "check_vma"
+        elif "check_rep" in params:
+            _check_kw = "check_rep"
+        else:
+            _check_kw = None
+        _sm = sm
+    return _sm, _check_kw
+
 
 def shard_map_fn(f, mesh, in_specs, out_specs):
-    """`jax.shard_map` across jax versions (new: check_vma, old: check_rep)."""
-    import jax
-
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map as sm_old  # type: ignore
-
-    return sm_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    """`shard_map` with replication checking off, across jax versions."""
+    sm, kw = _resolve_shard_map()
+    kwargs = {kw: False} if kw else {}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import Any, Optional, Sequence, Tuple
 
 from ..store import Store
-from ..types import ReduceOp, Work
+from ..types import DistError, ReduceOp, Work
 from .base import Backend
 
 
@@ -60,8 +60,8 @@ class ProcessGroupWrapper(Backend):
 
         try:
             host = np.asarray(x)
-        except Exception:
-            return
+        except (TypeError, ValueError):
+            return  # non-array payload (e.g. barrier None): nothing to audit
         name = host.dtype.name
         if name == "float64":
             # scan at full precision: a downcast would overflow large finite
@@ -103,8 +103,8 @@ class ProcessGroupWrapper(Backend):
             if seq > 1 and hasattr(self.store, "delete_key"):
                 try:
                     self.store.delete_key(f"pgw/{seq - 1}/all")
-                except Exception:
-                    pass
+                except (DistError, OSError):
+                    pass  # best-effort GC of the previous round's key
             return
         self.store.set(f"pgw/{seq}/{self.my_rank}", fp)
         keys = [f"pgw/{seq}/{r}" for r in range(self.world_size)]
@@ -121,8 +121,8 @@ class ProcessGroupWrapper(Backend):
         if seq > 1 and hasattr(self.store, "delete_key"):
             try:
                 self.store.delete_key(f"pgw/{seq - 1}/{self.my_rank}")
-            except Exception:
-                pass
+            except (DistError, OSError):
+                pass  # best-effort GC of the previous round's key
 
     # -- delegated collectives --------------------------------------------
     def allreduce(self, x, op: Any = ReduceOp.SUM):

@@ -27,17 +27,6 @@ from .base import Backend
 AXIS = "_ranks"
 
 
-def _shard_map():
-    import jax
-
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm
-    from jax.experimental.shard_map import shard_map as sm  # type: ignore
-
-    return sm
-
-
 def _fold_op(op: ReduceOp):
     """Local fold used for ops with no dedicated ICI primitive."""
     import jax.numpy as jnp
@@ -83,15 +72,15 @@ class XlaBackend(Backend):
         import jax
         from jax.sharding import PartitionSpec as P
 
+        from .._compat import shard_map_fn
+
         prog = self._progs.get(key)
         if prog is None:
-            sm = _shard_map()
-            mapped = sm(
+            mapped = shard_map_fn(
                 local_fn,
                 mesh=self.mesh.jax_mesh,
                 in_specs=P(AXIS),
                 out_specs=P(AXIS),
-                check_vma=False,
             )
             prog = jax.jit(mapped)
             self._progs[key] = prog

@@ -39,12 +39,13 @@ import numpy as np
 
 from . import backends as _backends
 from . import faults as _faults
+from . import schedule as _schedule
 from .backends.base import Backend as _BackendBase
 from .mesh import DeviceMesh, init_device_mesh
 from .rendezvous import rendezvous as _rendezvous
 from .store import HashStore, PrefixStore, Store
 from .tensor import DistTensor
-from .types import ArrayWork, CompletedWork, OpType, ReduceOp, Work
+from .types import ArrayWork, CompletedWork, DistError, OpType, ReduceOp, Work
 
 logger = logging.getLogger(__name__)
 
@@ -133,6 +134,7 @@ class ProcessGroup:
 
         self.status = ProcessGroupStatus()
         self.watchdog = None  # set by enable_watchdog()
+        self._sched = None  # ScheduleVerifier, set under TDX_SCHEDULE_CHECK=1
         self._inflight: List = []  # (work, done_cb) pending completion sweep
 
     def enable_watchdog(self, timeout_s: Optional[float] = None, **kw):
@@ -159,10 +161,14 @@ class ProcessGroup:
                 still.append((work, done))
         self._inflight = still
 
-    def _dispatch(self, op_name: str, array, fn):
+    def _dispatch(self, op_name: str, array, fn, detail: str = ""):
         """Run one collective with full observability: sequence number,
         ProcessGroupStatus, FlightRecorder entry, watchdog registration,
-        completion sweep."""
+        completion sweep. `detail` carries op parameters that must agree
+        across ranks but are invisible in (op, shape, dtype) — the
+        reduce op, broadcast source, permute pairs — so the schedule
+        fingerprint (TDX_SCHEDULE_CHECK) catches e.g. rank 0 running
+        SUM while rank 1 runs MAX."""
         from .utils.flight_recorder import global_recorder
 
         self._sweep_inflight()
@@ -172,6 +178,12 @@ class ProcessGroup:
         for s in shape:
             numel *= int(s)
         dtype = getattr(array, "dtype", "")
+        # schedule fingerprint BEFORE any dispatch bookkeeping: a
+        # divergence diagnostic must fire before the op could wedge the
+        # transport, and a raise here must not leave a forever-enqueued
+        # flight-recorder entry
+        if self._sched is not None:
+            self._sched.record(seq, op_name, shape, str(dtype), detail)
         self.status.record_enqueue(seq, op_name, numel)
         rec = global_recorder()
         rec.record(seq, op_name, self.group_name, shape, dtype, numel)
@@ -532,6 +544,23 @@ def _new_group_internal(
             driver_mode=_world.mode != "multiproc",
         )
     pg = ProcessGroup(flat, ranks, backend_name, backend, store, name, tsec)
+    if _schedule.enabled() and store is not None:
+        # multiproc: group-rank keyed agreement through the store (a
+        # non-member process constructs the group collectively but never
+        # dispatches, so it carries no verifier). Driver mode: one
+        # caller issues every rank's schedule, so agreement is
+        # structural — world=1 keeps the fingerprint path (and the
+        # schedule.mismatch fault seam) live without store traffic.
+        if _world.mode == "multiproc":
+            my = ranks.index(_world.process_rank) \
+                if _world.process_rank in ranks else -1
+            w = len(ranks)
+        else:
+            my, w = 0, 1
+        if my >= 0:
+            pg._sched = _schedule.ScheduleVerifier(
+                PrefixStore("sched", store), my, w, name
+            )
     # watchdog coverage follows the default group: torch's NCCL watchdog
     # scans every PG, not just WORLD — a hang on a subgroup collective
     # must trip detection the same way (round-4 advisor)
@@ -560,8 +589,17 @@ def new_group(
             raise ValueError(f"rank {r} not in world {world.ranks}")
     name = group_desc or f"group_{_world.group_count}"
     tsec = _timeout_seconds(timeout) if timeout is not None else world.timeout
+    # Incarnation-scoped like the default pg's prefix: group names
+    # ("group_N") reset with _world on every init/destroy cycle, so under
+    # an elastic restart with a PERSISTENT store daemon a bare name would
+    # leak the dead incarnation's keys (pgw fingerprints, monitored-
+    # barrier rounds, sched checkpoints, objcnt rounds) into the new gang
+    # — e.g. a stale sched/<round> key satisfies the new verifier's wait
+    # instantly and raises a spurious ScheduleMismatchError.
     store = (
-        PrefixStore(name, _world.store) if _world.store is not None else None
+        PrefixStore(f"{name}_gen{_world.scope}", _world.store)
+        if _world.store is not None
+        else None
     )
     submesh = world.mesh.submesh([world.ranks.index(r) for r in ranks])
     return _new_group_internal(
@@ -633,12 +671,18 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
                             min(30.0, _world.default_pg.timeout),
                         )
                 except Exception:
-                    pass  # peers may have crashed; never hang teardown
+                    # peers may have crashed; never hang teardown — but
+                    # leave a trace for post-mortems (R005 triage)
+                    logger.debug(
+                        "teardown departure handshake failed", exc_info=True
+                    )
             if hasattr(st, "close"):
                 try:
                     st.close()
                 except Exception:
-                    pass
+                    logger.debug(
+                        "store close failed during teardown", exc_info=True
+                    )
         _world = _WorldState()
         GroupMember.WORLD = None
     else:
@@ -680,7 +724,7 @@ def _install_rank_excepthook() -> None:
         old_stderr_write = sys.stderr.write
         try:
             sys.stderr.write(f"{prefix}: ")
-        except Exception:
+        except Exception:  # distlint: disable=R005 -- excepthook must never itself raise; stderr may be closed
             pass
         old_hook(exc_type, exc_value, exc_tb)
 
@@ -721,7 +765,12 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool =
     DistTensor; lowers to `lax.psum`/`pmean`/... over the group mesh."""
     g = _resolve(group)
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch("all_reduce", dt.array, lambda: g.backend_impl.allreduce(dt.array, op))
+    out, work = g._dispatch(
+        "all_reduce",
+        dt.array,
+        lambda: g.backend_impl.allreduce(dt.array, op),
+        detail=str(op),
+    )
     return _finish(dt, out, work, async_op)
 
 
@@ -730,7 +779,12 @@ def broadcast(tensor, src: int, group=None, async_op: bool = False):
     g = _resolve(group)
     g._check_member(src)
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch("broadcast", dt.array, lambda: g.backend_impl.broadcast(dt.array, src))
+    out, work = g._dispatch(
+        "broadcast",
+        dt.array,
+        lambda: g.backend_impl.broadcast(dt.array, src),
+        detail=f"src={src}",
+    )
     return _finish(dt, out, work, async_op)
 
 
@@ -740,7 +794,12 @@ def reduce(tensor, dst: int, op: ReduceOp = ReduceOp.SUM, group=None, async_op: 
     g = _resolve(group)
     g._check_member(dst)
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch("reduce", dt.array, lambda: g.backend_impl.reduce(dt.array, dst, op))
+    out, work = g._dispatch(
+        "reduce",
+        dt.array,
+        lambda: g.backend_impl.reduce(dt.array, dst, op),
+        detail=f"dst={dst},{op}",
+    )
     return _finish(dt, out, work, async_op)
 
 
@@ -761,7 +820,12 @@ def gather(tensor, dst: int = 0, group=None, async_op: bool = False):
     g = _resolve(group)
     g._check_member(dst)
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch("gather", dt.array, lambda: g.backend_impl.gather(dt.array, dst))
+    out, work = g._dispatch(
+        "gather",
+        dt.array,
+        lambda: g.backend_impl.gather(dt.array, dst),
+        detail=f"dst={dst}",
+    )
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -777,7 +841,12 @@ def scatter(tensor, src: int = 0, group=None, async_op: bool = False):
         raise ValueError(
             f"scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
         )
-    out, work = g._dispatch("scatter", dt.array, lambda: g.backend_impl.scatter(dt.array, src))
+    out, work = g._dispatch(
+        "scatter",
+        dt.array,
+        lambda: g.backend_impl.scatter(dt.array, src),
+        detail=f"src={src}",
+    )
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -792,7 +861,12 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bo
         raise ValueError(
             f"reduce_scatter input per-rank leading dim {dt.shape[0]} != world {g.size()}"
         )
-    out, work = g._dispatch("reduce_scatter", dt.array, lambda: g.backend_impl.reduce_scatter(dt.array, op))
+    out, work = g._dispatch(
+        "reduce_scatter",
+        dt.array,
+        lambda: g.backend_impl.reduce_scatter(dt.array, op),
+        detail=str(op),
+    )
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -1308,6 +1382,7 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Work]:
             "batch_isend_irecv",
             src_dt.array,
             lambda src_dt=src_dt, perm=perm: g.backend_impl.permute(src_dt.array, perm),
+            detail=f"perm={perm}",
         )
         for _, s, r in entries:
             r.tensor._set(out)
@@ -1435,8 +1510,8 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
             parts.append(g.store.get(ck))
             try:
                 g.store.delete_key(ck)
-            except Exception:
-                pass
+            except (DistError, OSError):
+                pass  # best-effort GC: a failed delete only leaks a consumed key
         payload = b"".join(parts)
         assert len(payload) == total, (len(payload), total)
         val = pickle.loads(payload)
@@ -1444,8 +1519,8 @@ def _store_recv(tensor, src: int, g: ProcessGroup, tag: int, timeout: float):
         val = pickle.loads(head)
     try:
         g.store.delete_key(key)
-    except Exception:
-        pass
+    except (DistError, OSError):
+        pass  # best-effort GC: a failed delete only leaks a consumed key
     if isinstance(tensor, np.ndarray):
         tensor[...] = val  # torch in-place recv contract
     return val
@@ -1548,7 +1623,10 @@ def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = Non
         raise ValueError("driver mode: send(...) needs src= (acting rank)")
     dt = _as_dist(tensor, g)
     out, work = g._dispatch(
-        "send", dt.array, lambda: g.backend_impl.permute(dt.array, [(src, dst)])
+        "send",
+        dt.array,
+        lambda: g.backend_impl.permute(dt.array, [(src, dst)]),
+        detail=f"{src}->{dst}",
     )
     dt._set(out)
     return None
@@ -1583,7 +1661,10 @@ def isend(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = No
         raise ValueError("driver mode: isend(...) needs src= (acting rank)")
     dt = _as_dist(tensor, g)
     out, work = g._dispatch(
-        "isend", dt.array, lambda: g.backend_impl.permute(dt.array, [(src, dst)])
+        "isend",
+        dt.array,
+        lambda: g.backend_impl.permute(dt.array, [(src, dst)]),
+        detail=f"{src}->{dst}",
     )
     dt._set(out)
     return work
@@ -1600,6 +1681,43 @@ def irecv(tensor, src: Optional[int] = None, group=None, tag: int = 0, *, dst: O
 # ---------------------------------------------------------------------------
 # object collectives — torch `distributed_c10d.py:3439,3925,4057`
 # ---------------------------------------------------------------------------
+
+
+def _verify_object_count_across_ranks(op: str, count: int, g: ProcessGroup) -> None:
+    """Agree on an object count before any count-shaped collective runs.
+
+    Store-based arrival keys (the `monitored_barrier` idiom — safe for
+    the same reason: object collectives are themselves collective, so a
+    per-group round counter agrees across ranks): every rank publishes
+    its count and reads everyone's, so on mismatch EVERY rank — src
+    included — raises the same ValueError naming the per-rank counts,
+    instead of one rank erroring while its peers wedge inside the next
+    collective. Store traffic only; object collectives are control-plane
+    by contract."""
+    if g.store is None:
+        return
+    g._objcnt_round = getattr(g, "_objcnt_round", 0) + 1
+    rnd = g._objcnt_round
+    me = g.rank()
+    g.store.set(f"objcnt/{rnd}/{me}", str(int(count)).encode())
+    keys = [f"objcnt/{rnd}/{r}" for r in range(g.size())]
+    g.store.wait(keys, g.timeout)
+    counts = {
+        r: int(g.store.get(f"objcnt/{rnd}/{r}").decode()) for r in range(g.size())
+    }
+    if rnd > 1:
+        # every rank has passed round rnd-1 (it reached rnd), so its keys
+        # are dead; best-effort GC bounds store growth
+        try:
+            g.store.delete_key(f"objcnt/{rnd - 1}/{me}")
+        except (DistError, OSError):
+            pass
+    if len(set(counts.values())) > 1:
+        raise ValueError(
+            f"{op}: object counts differ across ranks: "
+            f"{dict(sorted(counts.items()))}; this rank holds {count}. "
+            "Every rank must pass the same number of objects."
+        )
 
 
 def _obj_to_array(obj) -> np.ndarray:
@@ -1657,6 +1775,14 @@ def broadcast_object_list(object_list: List[Any], src: int = 0, group=None) -> N
     W = g.size()
     if _world.mode == "multiproc":
         k = len(object_list)
+        # Mismatched object counts across ranks used to be UNDEFINED: the
+        # (k,)-shaped metadata broadcast below assembles a global array
+        # from per-rank shards, so differing k misassembles it silently.
+        # Pin it down with the DDP param-verification idiom (MIN==MAX
+        # agreement): EVERY rank — src included — raises the same
+        # diagnostic, so no rank proceeds into a collective its peers
+        # abandoned (tests/test_object_collectives_counts.py).
+        _verify_object_count_across_ranks("broadcast_object_list", k, g)
         # torch ignores non-src contents pre-call; don't even pickle them
         # (placeholders may be unpicklable or large)
         if g.rank() == src:

@@ -32,6 +32,13 @@ Named injection points wired in this package:
     rendezvous.join                                (rendezvous handlers)
     p2p.connect / p2p.send                         (direct data plane)
     collective.dispatch                            (eager collective path)
+    schedule.mismatch                              (TDX_SCHEDULE_CHECK
+                                                    fingerprint; action
+                                                    "corrupt" perturbs the
+                                                    firing rank's schedule
+                                                    fingerprint so the next
+                                                    checkpoint reports a
+                                                    divergence — schedule.py)
     agent.heartbeat                                (node-elastic heartbeats)
     checkpoint.write / checkpoint.finalize         (integrity layer)
     train.step                                     (for worker scripts; fired
@@ -47,7 +54,7 @@ Actions:
     drop     raise FaultTimeout (a TimeoutError) — request silently dropped
     stale    signal the call site to serve a stale read (store GET)
     corrupt  signal the call site to corrupt the payload (NaN injection,
-             checkpoint bit-flips)
+             checkpoint bit-flips, schedule-fingerprint perturbation)
     error    raise DistError(rule["message"])
     crash    os._exit(rule.get("exit_code", 13)) — rank crash mid-step
 

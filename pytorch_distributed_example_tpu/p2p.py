@@ -41,6 +41,7 @@ the transport instead of an application ack.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
@@ -53,6 +54,8 @@ import numpy as np
 
 from . import faults
 from .utils.retry import RetryPolicy, call_with_retry
+
+logger = logging.getLogger(__name__)
 
 _HELLO = struct.Struct("<I")
 # frame header: route_len, tag, seq, kind(0=nd 1=pkl), ndim, dtype_len,
@@ -412,8 +415,16 @@ class P2PPlane:
                         and self._waiting == 0
                     ):
                         self._cond.wait(0.5)
-        except (OSError, EOFError, ValueError):
-            pass  # peer closed (or sent garbage); delivered messages stay
+        except (OSError, EOFError):
+            pass  # peer closed; delivered messages stay
+        except ValueError:
+            # a frame failed validation: protocol mismatch or hostile
+            # peer — not a normal close, so leave a trace (R005-spirit
+            # triage: dispatch-path failures must not vanish silently)
+            logger.warning(
+                "p2p reader from rank %s dropped the connection on a "
+                "malformed frame", src, exc_info=True,
+            )
         finally:
             try:
                 conn.close()

@@ -43,15 +43,7 @@ from ..types import ReduceOp
 from . import comm_hooks
 
 
-def _shard_map():
-    import jax
-
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm
-    from jax.experimental.shard_map import shard_map as sm  # type: ignore
-
-    return sm
+from .._compat import shard_map_fn as _shard_map_fn
 
 
 def _named_leaves(params):
@@ -425,16 +417,14 @@ def make_ddp_train_step(
             )
             return p, o, hs, losses, None
 
-    sm = _shard_map()
     # with steps_per_call the data's leading axis is the step index, so
     # the dp shard moves to axis 1; per-step rngs stay replicated
     data_spec = P(None, axis) if steps_per_call > 1 else P(axis)
-    mapped = sm(
+    mapped = _shard_map_fn(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), data_spec, data_spec, P()),
         out_specs=(P(), P(), P(axis), P(), P()),
-        check_vma=False,
     )
     jitted = jax.jit(mapped, donate_argnums=(0, 1, 2))
 
@@ -459,8 +449,8 @@ def make_ddp_train_step(
         fwd = (lambda p, xa: apply_fn(p, xa, rng)) if has_rng else apply_fn
         try:
             _, unused = _live_param_names(fwd, params, x)
-        except Exception:
-            return  # diagnostics must never break the train step
+        except Exception:  # distlint: disable=R005 -- advisory jaxpr probe: diagnostics must never break the train step
+            return
         if not unused:
             return
         if find_unused_parameters:
@@ -583,13 +573,11 @@ def make_eval_step(apply_fn: Callable, metric_fn: Callable, group=None):
         m = metric_fn(logits, y, w)
         return lax.psum(m, axis)
 
-    sm = _shard_map()
-    mapped = sm(
+    mapped = _shard_map_fn(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis)),
         out_specs=P(),
-        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -750,15 +738,13 @@ class DistributedDataParallel:
         mesh = g.mesh.jax_mesh
         axis = g.mesh.axis_names[0]
         apply = lambda p, xa: self.module.apply(p, xa)
-        sm = _shard_map()
 
         fwd = jax.jit(
-            sm(
+            _shard_map_fn(
                 apply,
                 mesh=mesh,
                 in_specs=(P(), P(axis)),
                 out_specs=P(axis),
-                check_vma=False,
             )
         )
 
@@ -766,12 +752,11 @@ class DistributedDataParallel:
             return loss_fn(apply(p, xm), ym)
 
         fwdbwd = jax.jit(
-            sm(
+            _shard_map_fn(
                 lambda p, xm, ym: jax.value_and_grad(obj)(p, xm, ym),
                 mesh=mesh,
                 in_specs=(P(), P(axis), P(axis)),
                 out_specs=(P(), P()),
-                check_vma=False,
             )
         )
 

@@ -215,17 +215,22 @@ class Reducer:
                 [leaves[i].reshape(W, -1) for i in idx_list], axis=1
             )
             bucket_no = len(in_flight)
+            # `detail` feeds the TDX_SCHEDULE_CHECK fingerprint: ranks
+            # disagreeing on the reduction (or on which hook runs) must
+            # diverge even when bucket shapes happen to match
             if self.comm_hook is not None:
                 out, work = self.group._dispatch(
                     f"reduce_bucket[{bucket_no}]",
                     flat,
                     lambda flat=flat: self.comm_hook(backend, flat),
+                    detail=getattr(self.comm_hook, "__name__", "comm_hook"),
                 )
             else:
                 out, work = self.group._dispatch(
                     f"reduce_bucket[{bucket_no}]",
                     flat,
                     lambda flat=flat: backend.allreduce(flat, ReduceOp.AVG),
+                    detail=str(ReduceOp.AVG),
                 )
             in_flight.append(
                 Bucket(idx_list, offsets, lengths, shapes, sum(lengths), work, out)
@@ -250,7 +255,8 @@ class Reducer:
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        from ..backends.xla import AXIS, _shard_map
+        from .._compat import shard_map_fn
+        from ..backends.xla import AXIS
 
         W = self.group.size()
         shapes = tuple(tuple(leaves[i].shape[1:]) for i in idx_list)
@@ -264,12 +270,11 @@ class Reducer:
         from ..types import lower_reduce_op
 
         # the one op->ICI lowering home (types.py), as the backend uses
-        reduce_flat = _shard_map()(
+        reduce_flat = shard_map_fn(
             lower_reduce_op(ReduceOp.AVG, AXIS),
             mesh=mesh,
             in_specs=P(AXIS),
             out_specs=P(AXIS),
-            check_vma=False,
         )
 
         @jax.jit
@@ -316,7 +321,8 @@ class Reducer:
                 shape=(W, total), dtype=bucket_leaves[0].dtype
             )
             outs, work = self.group._dispatch(
-                f"reduce_bucket[{bno}]", payload, run
+                f"reduce_bucket[{bno}]", payload, run,
+                detail=str(ReduceOp.AVG),
             )
             in_flight.append((idx_list, outs, work))
         for idx_list, outs, work in in_flight:

@@ -16,6 +16,7 @@ tests, barriers, the debug wrapper and elastic restart logic sit on it.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import struct
@@ -26,6 +27,8 @@ from typing import Dict, List, Optional
 from . import faults
 from .types import DistStoreError, DistTimeoutError
 from .utils.retry import RetryPolicy, call_with_retry
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 29500  # torch TCPStore.hpp:87
 _DEFAULT_TIMEOUT = 300.0
@@ -577,7 +580,10 @@ class TCPStore(Store):
             try:
                 self._lib.tdx_store_client_close(self._native_client)
             except Exception:
-                pass
+                # the connection is being discarded either way; a close
+                # failure is unreportable to the caller but worth a trace
+                # (R005 triage)
+                logger.debug("native store client close failed", exc_info=True)
             self._native_client = None
 
     def _transport_locked(self, cmd: int, key: str, val: bytes,

@@ -1,0 +1,884 @@
+"""distlint — collective-divergence static analyzer for this package.
+
+The classic failure mode of a c10d-shaped runtime is *silent schedule
+divergence*: two ranks issue different collective sequences (one gated a
+collective on `rank == 0`, one swallowed an exception and continued, one
+forgot to forward `group=`) and the job hangs — or, under `psum`, returns
+wrong numerics with no error at all. PCCL and "The Big Send-off"
+(PAPERS.md) both treat cross-replica schedule consistency as the
+correctness contract for scalable collectives. distlint enforces the
+static half of that contract over this repo's ~15 collective entry
+points; the runtime half is the `TDX_SCHEDULE_CHECK` fingerprint
+verifier in `distributed.ProcessGroup._dispatch` (`schedule.py`) — the
+two cross-validate each other: everything distlint allows should
+fingerprint identically across ranks, and everything the verifier trips
+on should have been distlint-visible at a call site.
+
+Rules
+-----
+
+R001  collective called under rank-dependent control flow — an `if` /
+      `while` / ternary whose test reads a rank-like value (`get_rank()`,
+      `.rank()`, `jax.process_index()`, names like `rank` / `is_main` /
+      `is_master`, or a variable assigned from one of those), including
+      statements *after* a rank-gated early `return` / `continue` /
+      `break` in the same block. Ranks disagreeing on whether a
+      collective runs is the canonical desync.
+R002  collective inside a `try` body whose broad handler (`except:` /
+      `except Exception` / `except BaseException`) swallows and
+      continues (no re-`raise`, no process exit): the excepting rank
+      abandons the collective sequence mid-stream while peers keep
+      waiting.
+R003  blocking store/rendezvous op (`store.get` / `store.wait` /
+      `store.barrier` / `rendezvous(...)` / `monitored_barrier`) issued
+      between an async collective launch (`async_op=True`) and its
+      `Work.wait()`: the store op can deadlock against the unfinished
+      collective's resources (and inverts the launch/drain order peers
+      assume).
+R004  a function that takes a `group` / `process_group` parameter but
+      calls a collective without forwarding it (neither the parameter
+      nor a variable derived from it appears in the call's arguments):
+      the collective silently runs on the DEFAULT group — wrong mesh,
+      wrong peers, schedule divergence between group members and
+      non-members.
+R005  broad `except`-and-`pass` (`except [Base]Exception: pass` or bare
+      `except: pass`) in dispatch-path modules (store / p2p / rendezvous
+      / watchdog / collective dispatch): a silently-swallowed failure on
+      the dispatch path is exactly how one rank's schedule starts
+      diverging without a trace.
+
+Suppressions
+------------
+
+A finding is suppressed by a comment on the flagged line or on its
+governing construct's first line (the `if`, `try`, `except` or `def`):
+
+    if rank == 0:  # distlint: disable=R001 -- post-join probe, all ranks converge below
+        dist.barrier(group)
+
+``# distlint: disable=R001,R004 -- why`` suppresses several rules at
+once; ``# distlint: disable-file=R003 -- why`` anywhere in a file
+suppresses the rule file-wide. Always append a reason after ``--``
+(`tests/test_distlint_self.py` fails reasonless suppressions).
+
+Configuration
+-------------
+
+``[tool.distlint]`` in pyproject.toml:
+
+    [tool.distlint]
+    paths = ["pytorch_distributed_example_tpu", "examples", "tests"]
+    exclude = ["csrc/"]
+    dispatch_path_modules = ["store.py", "p2p.py", "..."]
+
+CLI
+---
+
+    python -m pytorch_distributed_example_tpu.tools.distlint [paths...]
+        [--json] [--show-suppressed] [--root DIR] [--no-config]
+
+Exit status: 0 clean, 1 unsuppressed findings, 2 bad invocation/parse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "lint_source",
+    "lint_file",
+    "lint_paths",
+    "load_config",
+    "main",
+]
+
+RULES = {
+    "R001": "collective under rank-dependent control flow",
+    "R002": "collective inside a try whose broad handler swallows and continues",
+    "R003": "blocking store/rendezvous op between a collective launch and its wait()",
+    "R004": "collective does not forward the enclosing function's group parameter",
+    "R005": "broad except swallows silently in a dispatch-path module",
+}
+
+# Collective entry points (the schedule-divergence surface). p2p ops
+# (send/recv/isend/irecv) are deliberately absent: they are rank-directed
+# by contract, so rank-gating them is the normal idiom, not a smell.
+COLLECTIVES: Set[str] = {
+    "all_reduce",
+    "broadcast",
+    "reduce",
+    "all_gather",
+    "gather",
+    "scatter",
+    "reduce_scatter",
+    "all_to_all",
+    "barrier",
+    "monitored_barrier",
+    "all_gather_into_tensor",
+    "all_to_all_single",
+    "reduce_scatter_tensor",
+    "all_gather_object",
+    "broadcast_object_list",
+    "scatter_object_list",
+    "gather_object",
+    "all_reduce_coalesced",
+    "all_gather_coalesced",
+    "batch_isend_irecv",
+}
+
+# Names that read as "which rank am I" in a condition.
+_RANK_NAME_RE = re.compile(
+    r"(^|_)(rank|ranks?_?id)($|_)|^(is_main|is_master|main_process|is_leader)$",
+    re.IGNORECASE,
+)
+# Calls whose RESULT is a rank: get_rank(), g.rank(), jax.process_index()
+_RANK_CALL_ATTRS = {"rank", "get_rank", "process_index", "get_node_local_rank"}
+# Attributes that hold a rank: _world.process_rank, self.my_rank ...
+_RANK_ATTR_RE = re.compile(r"rank", re.IGNORECASE)
+
+# Blocking store ops for R003 (`check` is a non-blocking probe; `set`
+# and `add` complete locally against a live daemon).
+_STORE_BLOCKING_ATTRS = {"get", "wait", "barrier"}
+
+# Modules whose broad-except hygiene R005 polices. Matched as path
+# suffixes against the posix-style relative path.
+DEFAULT_DISPATCH_PATH_MODULES = [
+    "pytorch_distributed_example_tpu/distributed.py",
+    "pytorch_distributed_example_tpu/store.py",
+    "pytorch_distributed_example_tpu/p2p.py",
+    "pytorch_distributed_example_tpu/rendezvous.py",
+    "pytorch_distributed_example_tpu/schedule.py",
+    "pytorch_distributed_example_tpu/utils/watchdog.py",
+    "pytorch_distributed_example_tpu/backends/wrapper.py",
+    "pytorch_distributed_example_tpu/backends/xla.py",
+    "pytorch_distributed_example_tpu/parallel/reducer.py",
+    "pytorch_distributed_example_tpu/parallel/ddp.py",
+]
+
+DEFAULT_PATHS = ["pytorch_distributed_example_tpu", "examples", "tests"]
+DEFAULT_EXCLUDE = ["csrc/"]
+
+_SUPPRESS_RE = re.compile(r"#\s*distlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*distlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+
+    def to_dict(self) -> Dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+
+@dataclass
+class LintConfig:
+    paths: List[str] = field(default_factory=lambda: list(DEFAULT_PATHS))
+    exclude: List[str] = field(default_factory=lambda: list(DEFAULT_EXCLUDE))
+    dispatch_path_modules: List[str] = field(
+        default_factory=lambda: list(DEFAULT_DISPATCH_PATH_MODULES)
+    )
+
+
+def load_config(root: str) -> LintConfig:
+    """Read ``[tool.distlint]`` from ``<root>/pyproject.toml`` (missing
+    file/section/parser → defaults)."""
+    cfg = LintConfig()
+    pp = os.path.join(root, "pyproject.toml")
+    if not os.path.isfile(pp):
+        return cfg
+    try:
+        try:
+            import tomllib  # py311+
+        except ImportError:
+            import tomli as tomllib  # py310 vendored parser
+        with open(pp, "rb") as f:
+            doc = tomllib.load(f)
+    except Exception as e:
+        raise ValueError(f"could not parse {pp}: {e}") from e
+    section = doc.get("tool", {}).get("distlint", {})
+    if "paths" in section:
+        cfg.paths = [str(p) for p in section["paths"]]
+    if "exclude" in section:
+        cfg.exclude = [str(p) for p in section["exclude"]]
+    if "dispatch_path_modules" in section:
+        cfg.dispatch_path_modules = [str(p) for p in section["dispatch_path_modules"]]
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# source-level helpers
+# ---------------------------------------------------------------------------
+
+
+def _parse_suppressions(src: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line -> suppressed rules, file-wide suppressed rules)."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+            per_line.setdefault(i, set()).update(rules)
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_wide.update(
+                r.strip().upper() for r in m.group(1).split(",") if r.strip()
+            )
+    return per_line, file_wide
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    """Trailing identifier of the called thing: `all_reduce`, `dist.all_reduce`,
+    `tdx.distributed.all_reduce` all resolve to "all_reduce"."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_collective_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _call_name(node) in COLLECTIVES
+    )
+
+
+def _expr_text_names(node: ast.AST) -> Set[str]:
+    """All bare identifier names appearing in an expression."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_rank_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does this expression read a rank-like value?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id in tainted or _RANK_NAME_RE.search(sub.id):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if _RANK_ATTR_RE.search(sub.attr):
+                return True
+        elif isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name in _RANK_CALL_ATTRS:
+                return True
+    return False
+
+
+def _rank_taint_targets(stmt: ast.stmt, tainted: Set[str]) -> Set[str]:
+    """Names newly rank-tainted by an assignment like ``me = g.rank()``."""
+    if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        return set()
+    value = stmt.value
+    if value is None or not _is_rank_expr(value, tainted):
+        return set()
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    else:
+        targets = [stmt.target]
+    out: Set[str] = set()
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+    return out
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    def broad_name(e: ast.expr) -> bool:
+        return isinstance(e, ast.Name) and e.id in ("Exception", "BaseException")
+
+    t = handler.type
+    if t is None:
+        return True
+    if broad_name(t):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(broad_name(e) for e in t.elts)
+    return False
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor exits the process."""
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return False
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name in ("_exit", "exit", "abort"):
+                return False
+    return True
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """R005 shape: the handler body does nothing observable (only `pass` /
+    `...` / a bare `return`) — the failure leaves no trace at all."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring/ellipsis
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None
+            or (isinstance(stmt.value, ast.Constant) and stmt.value.value is None)
+        ):
+            continue
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the analyzer
+# ---------------------------------------------------------------------------
+
+
+class _FunctionAnalyzer:
+    """Per-scope walker. A "scope" is a module body or one function body;
+    nested functions are analyzed in their own scope (they do not inherit
+    the outer scope's rank gating — they may run elsewhere)."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    # -- entry points ------------------------------------------------------
+
+    def run_module(self, tree: ast.Module) -> None:
+        self._scan_scope(tree.body, func=None)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(node.body, func=node)
+
+    # -- scope scan --------------------------------------------------------
+
+    def _scan_scope(self, body: List[ast.stmt], func) -> None:
+        group_param = None
+        group_derived: Set[str] = set()
+        if func is not None:
+            arg_names = [a.arg for a in (
+                func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+            )]
+            for cand in ("group", "process_group"):
+                if cand in arg_names:
+                    group_param = cand
+                    break
+            if group_param:
+                group_derived = {group_param}
+
+        state = _ScopeState(
+            tainted=set(),
+            group_param=group_param,
+            group_derived=group_derived,
+            func=func,
+        )
+        self._scan_block(body, state, rank_gate=None, anchors=())
+
+    def _scan_block(
+        self,
+        body: List[ast.stmt],
+        state: "_ScopeState",
+        rank_gate: Optional[int],
+        anchors: Tuple[int, ...],
+    ) -> None:
+        """Walk one statement list. ``rank_gate`` is the line of the
+        innermost rank-dependent branch governing this block (None when
+        unconditional); ``anchors`` are extra suppression anchor lines."""
+        gate = rank_gate
+        for stmt in body:
+            # rank taint propagation (me = g.rank(), ...)
+            state.tainted |= _rank_taint_targets(stmt, state.tainted)
+            # group derivation (g = _resolve(group), pg = group or WORLD)
+            state.absorb_group_derivation(stmt)
+
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # analyzed as its own scope
+            if isinstance(stmt, ast.ClassDef):
+                # methods get their own scopes; class-level statements
+                # keep the current gate
+                self._scan_block(stmt.body, state, gate, anchors)
+                continue
+
+            if isinstance(stmt, (ast.If, ast.While)):
+                test_is_rank = _is_rank_expr(stmt.test, state.tainted)
+                inner_gate = stmt.lineno if test_is_rank else gate
+                self._visit_exprs(stmt.test, state, gate, anchors)
+                self._scan_block(
+                    stmt.body, state, inner_gate, anchors + (stmt.lineno,)
+                )
+                self._scan_block(
+                    stmt.orelse, state, inner_gate, anchors + (stmt.lineno,)
+                )
+                # rank-gated early exit: the REST of this block only runs
+                # on the ranks that did not return/continue/break
+                if test_is_rank and gate is None and _block_diverts(stmt.body):
+                    gate = stmt.lineno
+                continue
+
+            if isinstance(stmt, ast.Try):
+                self._scan_try(stmt, state, gate, anchors)
+                continue
+
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._visit_exprs(stmt.iter, state, gate, anchors)
+                self._scan_block(stmt.body, state, gate, anchors)
+                self._scan_block(stmt.orelse, state, gate, anchors)
+                continue
+
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._visit_exprs(item.context_expr, state, gate, anchors)
+                self._scan_block(stmt.body, state, gate, anchors)
+                continue
+
+            self._visit_exprs(stmt, state, gate, anchors)
+
+        # R003 runs over the scope linearly once per scope (see run below)
+
+    def _scan_try(
+        self,
+        stmt: ast.Try,
+        state: "_ScopeState",
+        gate: Optional[int],
+        anchors: Tuple[int, ...],
+    ) -> None:
+        swallowing = [
+            h
+            for h in stmt.handlers
+            if _handler_is_broad(h) and _handler_swallows(h)
+        ]
+        try_anchors = anchors + (stmt.lineno,)
+        if swallowing:
+            h = swallowing[0]
+            for sub_stmt in stmt.body:
+                # skip nested def/lambda bodies: a collective defined (not
+                # called) inside the try executes in another scope, outside
+                # the swallowing handler
+                for call in (
+                    n
+                    for n in _walk_skip_nested_funcs(sub_stmt)
+                    if _is_collective_call(n)
+                ):
+                    self._emit(
+                        "R002",
+                        call,
+                        f"collective `{_call_name(call)}` inside a try whose "
+                        f"broad handler (line {h.lineno}) swallows and "
+                        "continues: an excepting rank abandons the "
+                        "collective schedule while peers keep waiting",
+                        try_anchors + (h.lineno,),
+                    )
+        self._scan_block(stmt.body, state, gate, try_anchors)
+        for h in stmt.handlers:
+            self._scan_block(h.body, state, gate, try_anchors + (h.lineno,))
+        self._scan_block(stmt.orelse, state, gate, try_anchors)
+        self._scan_block(stmt.finalbody, state, gate, try_anchors)
+
+    def _visit_exprs(
+        self,
+        node: ast.AST,
+        state: "_ScopeState",
+        gate: Optional[int],
+        anchors: Tuple[int, ...],
+    ) -> None:
+        for call in (n for n in ast.walk(node) if _is_collective_call(n)):
+            name = _call_name(call)
+            if gate is not None:
+                self._emit(
+                    "R001",
+                    call,
+                    f"collective `{name}` runs only on ranks satisfying the "
+                    f"rank-dependent branch at line {gate}; ranks that skip "
+                    "it desynchronize the collective schedule",
+                    anchors + (gate,),
+                )
+            if state.group_param and not self._forwards_group(call, state):
+                self._emit(
+                    "R004",
+                    call,
+                    f"collective `{name}` does not forward this function's "
+                    f"`{state.group_param}` parameter — it will run on the "
+                    "default group instead of the caller's",
+                    anchors + ((state.func.lineno,) if state.func else ()),
+                )
+
+    def _forwards_group(self, call: ast.Call, state: "_ScopeState") -> bool:
+        # method call on the group itself (g.backend_impl.barrier(), ...)
+        if isinstance(call.func, ast.Attribute) and (
+            _expr_text_names(call.func.value) & state.group_derived
+        ):
+            return True
+        for kw in call.keywords:
+            if kw.arg in ("group", "process_group") or kw.arg is None:
+                if kw.value is not None and (
+                    _expr_text_names(kw.value) & state.group_derived
+                ):
+                    return True
+        for arg in call.args:
+            if _expr_text_names(arg) & state.group_derived:
+                return True
+        return False
+
+    def _emit(
+        self, rule: str, node: ast.AST, message: str, anchors: Tuple[int, ...]
+    ) -> None:
+        self.findings.append(
+            Finding(
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0) + 1,
+                rule=rule,
+                message=message,
+            )
+        )
+        # stash anchors for the suppression pass
+        self.findings[-1]._anchors = anchors  # type: ignore[attr-defined]
+
+
+@dataclass
+class _ScopeState:
+    tainted: Set[str]
+    group_param: Optional[str]
+    group_derived: Set[str]
+    func: Optional[ast.AST]
+
+    def absorb_group_derivation(self, stmt: ast.stmt) -> None:
+        """``g = _resolve(group)`` makes ``g`` group-derived too."""
+        if self.group_param is None:
+            return
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        value = stmt.value
+        if value is None or not (_expr_text_names(value) & self.group_derived):
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.group_derived.add(t.id)
+
+
+def _block_diverts(body: List[ast.stmt]) -> bool:
+    """Does this block end by leaving the enclosing block (early exit)?"""
+    if not body:
+        return False
+    last = body[-1]
+    return isinstance(last, (ast.Return, ast.Continue, ast.Break))
+
+
+# -- R003: linear launch/store-op/wait ordering per scope -------------------
+
+
+class _AsyncWindowAnalyzer:
+    """Scans each scope's statements in source order, tracking how many
+    async collective launches are outstanding; a blocking store /
+    rendezvous op inside that window is flagged."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def run_module(self, tree: ast.Module) -> None:
+        self._scan(tree.body)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan(node.body)
+
+    def _scan(self, body: List[ast.stmt]) -> None:
+        events: List[Tuple[int, str, ast.Call]] = []
+        for stmt in body:
+            for node in _walk_skip_nested_funcs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._classify(node)
+                if kind:
+                    events.append((getattr(node, "lineno", 0), kind, node))
+        events.sort(key=lambda e: e[0])
+        outstanding = 0
+        for line, kind, call in events:
+            if kind == "launch":
+                outstanding += 1
+            elif kind == "wait":
+                outstanding = 0
+            elif kind == "store" and outstanding > 0:
+                self.findings.append(
+                    Finding(
+                        path=self.path,
+                        line=line,
+                        col=getattr(call, "col_offset", 0) + 1,
+                        rule="R003",
+                        message=(
+                            f"blocking store/rendezvous op "
+                            f"`{_render_callee(call)}` issued while "
+                            f"{outstanding} async collective launch(es) are "
+                            "outstanding (no intervening Work.wait()): the "
+                            "store op can deadlock against the unfinished "
+                            "collective"
+                        ),
+                    )
+                )
+                self.findings[-1]._anchors = ()  # type: ignore[attr-defined]
+
+    def _classify(self, call: ast.Call) -> Optional[str]:
+        name = _call_name(call)
+        if name in COLLECTIVES:
+            for kw in call.keywords:
+                if (
+                    kw.arg == "async_op"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    return "launch"
+            return None
+        if name == "wait":
+            f = call.func
+            if isinstance(f, ast.Attribute) and _receiver_mentions_store(f.value):
+                return "store"
+            return "wait"
+        if name in _STORE_BLOCKING_ATTRS:
+            f = call.func
+            if isinstance(f, ast.Attribute) and _receiver_mentions_store(f.value):
+                return "store"
+            return None
+        if name in ("rendezvous", "monitored_barrier"):
+            return "store"
+        return None
+
+
+def _walk_skip_nested_funcs(stmt: ast.stmt):
+    """ast.walk that does not descend into nested function/lambda bodies
+    (deferred execution: each function body is scanned as its own scope
+    by run_module; lambda bodies run whenever the lambda is called)."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # its body is its own (deferred) scope
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _receiver_mentions_store(expr: ast.expr) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and "store" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "store" in sub.attr.lower():
+            return True
+    return False
+
+
+def _render_callee(call: ast.Call) -> str:
+    f = call.func
+    parts: List[str] = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+# -- R005 -------------------------------------------------------------------
+
+
+def _scan_silent_excepts(path: str, tree: ast.Module, findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if _handler_is_broad(h) and _handler_is_silent(h):
+                findings.append(
+                    Finding(
+                        path=path,
+                        line=h.lineno,
+                        col=h.col_offset + 1,
+                        rule="R005",
+                        message=(
+                            "broad `except` swallows silently in a "
+                            "dispatch-path module; raise a typed exception, "
+                            "log, or suppress with a reason"
+                        ),
+                    )
+                )
+                findings[-1]._anchors = (node.lineno,)  # type: ignore[attr-defined]
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _is_dispatch_path(rel_path: str, config: LintConfig) -> bool:
+    p = rel_path.replace(os.sep, "/")
+    return any(
+        p == m or p.endswith("/" + m) or fnmatch.fnmatch(p, m)
+        for m in config.dispatch_path_modules
+    )
+
+
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    config: Optional[LintConfig] = None,
+    dispatch_path: Optional[bool] = None,
+) -> List[Finding]:
+    """Lint one source string. ``dispatch_path`` forces R005 scanning on
+    or off (None: decided from ``path`` against the config)."""
+    config = config or LintConfig()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path=path,
+                line=e.lineno or 0,
+                col=(e.offset or 0),
+                rule="E000",
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    findings: List[Finding] = []
+    _FunctionAnalyzer(path, findings).run_module(tree)
+    _AsyncWindowAnalyzer(path, findings).run_module(tree)
+    if dispatch_path is None:
+        dispatch_path = _is_dispatch_path(path, config)
+    if dispatch_path:
+        _scan_silent_excepts(path, tree, findings)
+
+    per_line, file_wide = _parse_suppressions(src)
+
+    def suppressed(f: Finding) -> bool:
+        if f.rule in file_wide or "ALL" in file_wide:
+            return True
+        lines = (f.line,) + tuple(getattr(f, "_anchors", ()))
+        for ln in lines:
+            rules = per_line.get(ln)
+            if rules and (f.rule in rules or "ALL" in rules):
+                return True
+        return False
+
+    for f in findings:
+        f.suppressed = suppressed(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_file(path: str, config: Optional[LintConfig] = None, root: str = ".") -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    rel = os.path.relpath(path, root)
+    return lint_source(src, rel, config)
+
+
+def _iter_py_files(paths: Sequence[str], exclude: Sequence[str], root: str):
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+            continue
+        if not os.path.isdir(full):
+            # a stale/typo'd path must FAIL, not lint nothing and report
+            # the repo clean — that would silently disable the gate
+            raise FileNotFoundError(
+                f"lint path does not exist (or is not a .py file / "
+                f"directory): {full}"
+            )
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d not in ("__pycache__",)]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                fp = os.path.join(dirpath, name)
+                rel = os.path.relpath(fp, root).replace(os.sep, "/")
+                if any(ex in rel for ex in exclude):
+                    continue
+                yield fp
+
+
+def lint_paths(
+    paths: Optional[Sequence[str]] = None,
+    root: str = ".",
+    config: Optional[LintConfig] = None,
+) -> List[Finding]:
+    config = config or load_config(root)
+    findings: List[Finding] = []
+    for fp in _iter_py_files(paths or config.paths, config.exclude, root):
+        findings.extend(lint_file(fp, config, root))
+    return findings
+
+
+def render_report(findings: List[Finding], show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if show_suppressed else active
+    for f in shown:
+        lines.append(f.render())
+    n_sup = sum(1 for f in findings if f.suppressed)
+    by_rule: Dict[str, int] = {}
+    for f in active:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) or "none"
+    lines.append(
+        f"distlint: {len(active)} finding(s) ({summary}); "
+        f"{n_sup} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distlint",
+        description="collective-divergence static analyzer (rules R001-R005)",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: config paths)")
+    ap.add_argument("--root", default=".", help="repo root (pyproject.toml location)")
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument(
+        "--no-config", action="store_true", help="ignore [tool.distlint] in pyproject"
+    )
+    args = ap.parse_args(argv)
+    try:
+        config = LintConfig() if args.no_config else load_config(args.root)
+    except ValueError as e:
+        print(f"distlint: {e}", file=sys.stderr)
+        return 2
+    try:
+        findings = lint_paths(args.paths or None, args.root, config)
+    except OSError as e:
+        print(f"distlint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        print(render_report(findings, args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,346 @@
+"""distlint unit fixtures: every rule R001-R005 has at least one positive
+(flagged) and one negative (clean) case, plus suppression and config
+coverage. Pure AST analysis — no jax, quick tier."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from pytorch_distributed_example_tpu.tools.distlint import (
+    LintConfig,
+    lint_source,
+    load_config,
+    main,
+)
+
+from tests._mp_util import REPO
+
+
+def _rules(src, path="x.py", dispatch_path=False):
+    findings = lint_source(
+        textwrap.dedent(src), path, dispatch_path=dispatch_path
+    )
+    return [(f.rule, f.suppressed) for f in findings]
+
+
+def _active(src, **kw):
+    return [r for r, sup in _rules(src, **kw) if not sup]
+
+
+class TestR001RankGated:
+    def test_positive_if_gate(self):
+        assert _active(
+            """
+            import pytorch_distributed_example_tpu as tdx
+
+            def f(t):
+                if tdx.get_rank() == 0:
+                    tdx.all_reduce(t)
+            """
+        ) == ["R001"]
+
+    def test_positive_tainted_variable_and_while(self):
+        assert _active(
+            """
+            def f(t, dist, g):
+                me = g.rank()
+                while me > 0:
+                    dist.broadcast(t, 0)
+            """
+        ) == ["R001"]
+
+    def test_positive_early_return_gates_the_rest(self):
+        assert _active(
+            """
+            def f(t, dist):
+                if dist.get_rank() != 0:
+                    return
+                dist.all_reduce(t)
+            """
+        ) == ["R001"]
+
+    def test_negative_unconditional_and_non_rank_gate(self):
+        assert _active(
+            """
+            def f(t, dist, step):
+                dist.all_reduce(t)
+                if step % 10 == 0:
+                    dist.barrier()
+            """
+        ) == []
+
+    def test_negative_rank_gated_logging_only(self):
+        assert _active(
+            """
+            def f(t, dist):
+                dist.all_reduce(t)
+                if dist.get_rank() == 0:
+                    print("loss", t)
+            """
+        ) == []
+
+
+class TestR002SwallowedCollective:
+    def test_positive_broad_handler_continues(self):
+        assert _active(
+            """
+            def f(t, dist, log):
+                try:
+                    dist.all_reduce(t)
+                except Exception:
+                    log.warning("oops")  # swallows and continues
+            """
+        ) == ["R002"]
+
+    def test_negative_handler_reraises(self):
+        assert _active(
+            """
+            def f(t, dist):
+                try:
+                    dist.all_reduce(t)
+                except Exception:
+                    raise RuntimeError("fatal") from None
+            """
+        ) == []
+
+    def test_negative_typed_handler(self):
+        assert _active(
+            """
+            def f(t, dist):
+                try:
+                    dist.all_reduce(t)
+                except ValueError:
+                    pass
+            """
+        ) == []
+
+    def test_negative_deferred_def_or_lambda_in_try(self):
+        # defining a collective-calling function inside the try is not
+        # executing one under the handler
+        assert _active(
+            """
+            def f(t, dist):
+                try:
+                    hook = lambda: dist.all_reduce(t)
+                    def later():
+                        dist.barrier()
+                except Exception:
+                    pass
+                return hook, later
+            """
+        ) == []
+
+
+class TestR003StoreOpInAsyncWindow:
+    def test_positive_store_get_before_wait(self):
+        assert _active(
+            """
+            def f(t, dist, store):
+                work = dist.all_reduce(t, async_op=True)
+                store.get("key")
+                work.wait()
+            """
+        ) == ["R003"]
+
+    def test_negative_store_op_after_wait(self):
+        assert _active(
+            """
+            def f(t, dist, store):
+                work = dist.all_reduce(t, async_op=True)
+                work.wait()
+                store.get("key")
+            """
+        ) == []
+
+    def test_negative_no_outstanding_launch(self):
+        assert _active(
+            """
+            def f(t, dist, store):
+                store.get("key")
+                dist.all_reduce(t)
+            """
+        ) == []
+
+
+class TestR004GroupNotForwarded:
+    def test_positive_dropped_group(self):
+        assert _active(
+            """
+            def f(t, group, dist):
+                dist.all_reduce(t)
+            """
+        ) == ["R004"]
+
+    def test_negative_forwarded_directly_and_via_derivation(self):
+        assert _active(
+            """
+            def f(t, group, dist):
+                dist.all_reduce(t, group=group)
+                g = dist._resolve(group)
+                dist.broadcast(t, 0, g)
+                g.backend_impl.barrier()
+            """
+        ) == []
+
+    def test_negative_no_group_parameter(self):
+        assert _active(
+            """
+            def f(t, dist):
+                dist.all_reduce(t)
+            """
+        ) == []
+
+
+class TestR005SilentBroadExcept:
+    def test_positive_pass_bare_and_return(self):
+        src = """
+            def f(x):
+                try:
+                    x()
+                except Exception:
+                    pass
+                try:
+                    x()
+                except:
+                    pass
+                try:
+                    x()
+                except BaseException:
+                    return
+            """
+        assert _active(src, dispatch_path=True) == ["R005", "R005", "R005"]
+
+    def test_negative_typed_or_logged_or_off_dispatch_path(self):
+        src_typed = """
+            def f(x, log):
+                try:
+                    x()
+                except (ValueError, OSError):
+                    pass
+                try:
+                    x()
+                except Exception:
+                    log.exception("failed")
+            """
+        assert _active(src_typed, dispatch_path=True) == []
+        src_silent = """
+            def f(x):
+                try:
+                    x()
+                except Exception:
+                    pass
+            """
+        # same silent shape is NOT policed outside dispatch-path modules
+        assert _active(src_silent, dispatch_path=False) == []
+
+
+class TestSuppressions:
+    def test_line_level(self):
+        rules = _rules(
+            """
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()  # distlint: disable=R001 -- intentional
+            """
+        )
+        assert rules == [("R001", True)]
+
+    def test_construct_anchor_line(self):
+        rules = _rules(
+            """
+            def f(t, dist):
+                if dist.get_rank() == 0:  # distlint: disable=R001 -- intentional
+                    dist.barrier()
+                    dist.all_reduce(t)
+            """
+        )
+        assert rules == [("R001", True), ("R001", True)]
+
+    def test_file_level(self):
+        rules = _rules(
+            """
+            # distlint: disable-file=R001 -- fixture: file-wide suppression
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()
+            """
+        )
+        assert rules == [("R001", True)]
+
+    def test_wrong_rule_does_not_suppress(self):
+        assert _active(
+            """
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()  # distlint: disable=R002 -- wrong rule
+            """
+        ) == ["R001"]
+
+
+class TestConfigAndCli:
+    def test_load_config_reads_repo_pyproject(self):
+        cfg = load_config(REPO)
+        assert "pytorch_distributed_example_tpu" in cfg.paths
+        assert any("store.py" in m for m in cfg.dispatch_path_modules)
+
+    def test_dispatch_path_matching_from_config(self):
+        cfg = load_config(REPO)
+        src = """
+            def f(x):
+                try:
+                    x()
+                except Exception:
+                    pass
+            """
+        flagged = lint_source(
+            textwrap.dedent(src),
+            "pytorch_distributed_example_tpu/store.py",
+            config=cfg,
+        )
+        clean = lint_source(
+            textwrap.dedent(src),
+            "pytorch_distributed_example_tpu/models/bert.py",
+            config=cfg,
+        )
+        assert [f.rule for f in flagged] == ["R005"]
+        assert clean == []
+
+    def test_cli_json_and_exit_codes(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, dist):\n"
+            "    if dist.get_rank() == 0:\n"
+            "        dist.all_reduce(t)\n"
+        )
+        rc = main([str(bad), "--root", str(tmp_path), "--json", "--no-config"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert [f["rule"] for f in out] == ["R001"]
+        good = tmp_path / "good.py"
+        good.write_text("def f(t, dist):\n    dist.all_reduce(t)\n")
+        rc = main([str(good), "--root", str(tmp_path), "--no-config"])
+        assert rc == 0
+
+    def test_missing_path_is_an_error_not_clean(self, tmp_path, capsys):
+        # a typo'd/stale path must not silently lint nothing and exit 0
+        rc = main(
+            [str(tmp_path / "nope.py"), "--root", str(tmp_path), "--no-config"]
+        )
+        assert rc == 2
+        assert "does not exist" in capsys.readouterr().err
+
+    def test_module_entrypoint(self):
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.distlint",
+                "--help",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+        )
+        assert out.returncode == 0
+        assert "R001" in out.stdout or "collective" in out.stdout

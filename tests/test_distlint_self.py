@@ -1,0 +1,47 @@
+"""Self-lint gate: distlint over the WHOLE repo must report zero
+unsuppressed findings, so every future PR is linted by the quick tier.
+
+Runs in-process over the `[tool.distlint]` config paths (package,
+examples, tests) — the exact scan `python -m
+pytorch_distributed_example_tpu.tools.distlint` performs from the repo
+root."""
+
+from pytorch_distributed_example_tpu.tools.distlint import (
+    lint_paths,
+    load_config,
+    render_report,
+)
+
+from tests._mp_util import REPO
+
+
+def test_repo_is_distlint_clean():
+    findings = lint_paths(root=REPO)
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "unsuppressed distlint findings:\n" + render_report(
+        findings
+    )
+
+
+def test_suppressions_carry_reasons():
+    """Every suppression in the repo must state a reason (`-- why`):
+    an unexplained suppression is just a hidden finding."""
+    import os
+    import re
+
+    cfg = load_config(REPO)
+    bad = []
+    pat = re.compile(r"#\s*distlint:\s*disable(?:-file)?=[A-Za-z0-9_,\s]+")
+    for path in cfg.paths:
+        for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, path)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in filenames:
+                if not name.endswith(".py"):
+                    continue
+                fp = os.path.join(dirpath, name)
+                with open(fp, encoding="utf-8") as fh:
+                    for i, line in enumerate(fh, 1):
+                        m = pat.search(line)
+                        if m and "--" not in line[m.end():]:
+                            bad.append(f"{fp}:{i}")
+    assert not bad, f"suppressions without a reason (`-- why`): {bad}"

@@ -1,0 +1,144 @@
+"""Object collectives under mismatched object counts across ranks.
+
+Before this coverage existed the behavior was UNDEFINED: driver mode
+validated list lengths, but a multiproc `broadcast_object_list` with
+per-rank `k` misassembled the (k,)-shaped metadata broadcast silently.
+Pinned-down contract:
+
+  * driver mode: ValueError naming the expected per-rank count (W);
+  * multiproc mode: a MIN==MAX count agreement (the DDP param-verify
+    idiom) runs first, and EVERY rank — src included — raises the same
+    ValueError naming the count range, so no rank proceeds into a
+    collective its peers abandoned (which would hang).
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+
+from tests._mp_util import REPO, free_port, worker_env
+
+
+class TestDriverModeCounts:
+    def test_all_gather_object_wrong_count_raises(self, world, world_size):
+        with pytest.raises(ValueError, match=f"one object per rank \\({world_size}\\)"):
+            tdx.all_gather_object(["only-one"], world)
+
+    def test_broadcast_object_list_wrong_count_raises(self, world, world_size):
+        with pytest.raises(ValueError, match=f"one slot per rank \\({world_size}\\)"):
+            tdx.broadcast_object_list(["a", "b"], src=0, group=world)
+
+    def test_scatter_object_list_wrong_count_raises(self, world, world_size):
+        out: list = []
+        with pytest.raises(ValueError, match=f"{world_size} objects"):
+            tdx.scatter_object_list(out, ["a"], src=0, group=world)
+
+    def test_correct_counts_round_trip(self, world, world_size):
+        objs = [{"rank": r} for r in range(world_size)]
+        gathered = tdx.all_gather_object(objs, world)
+        assert gathered == objs
+        slots = [None] * world_size
+        slots[0] = ("payload", 7)
+        tdx.broadcast_object_list(slots, src=0, group=world)
+        assert slots == [("payload", 7)] * world_size
+
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+    rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+    mode = sys.argv[5]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        pass  # older jax: one CPU device per process is the default
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{jport}",
+        num_processes=world,
+        process_id=rank,
+    )
+
+    import pytorch_distributed_example_tpu as tdx
+
+    tdx.init_process_group(
+        backend="xla",
+        init_method=f"tcp://127.0.0.1:{sport}",
+        rank=rank,
+        world_size=world,
+    )
+
+    if mode == "mismatch":
+        objs = [f"obj{rank}-{i}" for i in range(2 + rank)]  # 2 vs 3 objects
+        try:
+            tdx.broadcast_object_list(objs, src=0)
+            print(f"NOERROR {rank}")
+            sys.exit(1)
+        except ValueError as e:
+            assert "{0: 2, 1: 3}" in str(e), str(e)
+            print(f"COUNTS {rank} {e}")
+            tdx.destroy_process_group()
+            sys.exit(9)
+    else:
+        # equal counts: the agreement protocol passes on every rank
+        # (payload movement itself needs device collectives — covered by
+        # test_multiprocess on backends that implement them)
+        from pytorch_distributed_example_tpu import distributed as dist
+
+        pg = dist._get_default_group()
+        dist._verify_object_count_across_ranks("probe", 2, pg)
+        dist._verify_object_count_across_ranks("probe", 5, pg)  # fresh round
+        print(f"MATCH {rank}")
+        tdx.destroy_process_group()
+    """
+)
+
+
+@pytest.mark.slow
+class TestMultiprocCounts:
+    def _run(self, tmp_path, mode):
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER)
+        jport, sport = free_port(), free_port()
+        env = worker_env()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(r), "2", str(jport),
+                 str(sport), mode],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=REPO,
+            )
+            for r in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail(f"object-count gang hung in mode {mode!r}")
+            outs.append(out.decode())
+        return procs, outs
+
+    def test_mismatched_counts_raise_on_every_rank_not_hang(self, tmp_path):
+        procs, outs = self._run(tmp_path, "mismatch")
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 9, f"rank {r}:\n{out}"
+            assert f"COUNTS {r}" in out
+            assert "object counts differ across ranks" in out
+
+    def test_matching_counts_broadcast_src_payload(self, tmp_path):
+        procs, outs = self._run(tmp_path, "match")
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r}:\n{out}"
+            assert f"MATCH {r}" in out

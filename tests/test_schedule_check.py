@@ -1,0 +1,392 @@
+"""TDX_SCHEDULE_CHECK coverage: the cross-rank collective-schedule
+fingerprint verifier (`schedule.py`) and its `_dispatch` wiring.
+
+Three layers:
+  * in-process unit tests of the agreement protocol (threads + HashStore);
+  * the chaos proof (quick tier, no jax in workers): a real 2-process
+    gang over the TCPStore where a seeded `schedule.mismatch` fault (or a
+    rank-gated skipped collective) is converted from a would-be hang into
+    a `ScheduleMismatchError` NAMING the divergent collective;
+  * driver-mode `_dispatch` wiring through a fake-backend subgroup.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from pytorch_distributed_example_tpu import faults
+from pytorch_distributed_example_tpu.schedule import (
+    ScheduleMismatchError,
+    ScheduleVerifier,
+)
+from pytorch_distributed_example_tpu.store import HashStore, PrefixStore
+
+from tests._mp_util import REPO, free_port
+
+
+def _pair(every=4, timeout=3.0):
+    store = HashStore(timeout=30.0)
+    return [
+        ScheduleVerifier(
+            PrefixStore("sched", store), r, 2, "g", every=every, timeout=timeout
+        )
+        for r in range(2)
+    ]
+
+
+def _run_ranks(fns):
+    """Run one callable per rank concurrently; return per-rank exceptions."""
+    errs = [None] * len(fns)
+
+    def call(i):
+        try:
+            fns[i]()
+        except Exception as e:  # noqa: BLE001 - recorded for assertions
+            errs[i] = e
+
+    ts = [threading.Thread(target=call, args=(i,)) for i in range(len(fns))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30.0)
+    return errs
+
+
+class TestVerifierProtocol:
+    def test_agreement_clears_window_and_raises_nothing(self):
+        v0, v1 = _pair(every=4)
+
+        def run(v):
+            for seq in range(8):
+                v.record(seq, "all_reduce", (4, 1), "float32", "ReduceOp.SUM")
+
+        errs = _run_ranks([lambda: run(v0), lambda: run(v1)])
+        assert errs == [None, None]
+        assert v0._window == [] and v1._window == []  # both checkpoints agreed
+        assert v0._round == 2
+
+    def test_divergent_op_named_on_both_ranks(self):
+        v0, v1 = _pair(every=4)
+
+        def run(v, rank):
+            for seq in range(4):
+                # rank 1's third call is a different collective
+                op = "broadcast" if (rank == 1 and seq == 2) else "all_reduce"
+                v.record(seq, op, (4, 1), "float32")
+
+        errs = _run_ranks([lambda: run(v0, 0), lambda: run(v1, 1)])
+        for e in errs:
+            assert isinstance(e, ScheduleMismatchError)
+        msg = str(errs[0])
+        assert "divergence" in msg
+        assert "#3" in msg  # first divergent call since last checkpoint
+        assert "all_reduce" in msg and "broadcast" in msg
+
+    def test_mismatched_detail_diverges_even_with_equal_shapes(self):
+        v0, v1 = _pair(every=2)
+
+        def run(v, detail):
+            v.record(0, "all_reduce", (4, 1), "float32", detail)
+            v.record(1, "all_reduce", (4, 1), "float32", detail)
+
+        errs = _run_ranks(
+            [
+                lambda: run(v0, "ReduceOp.SUM"),
+                lambda: run(v1, "ReduceOp.MAX"),
+            ]
+        )
+        for e in errs:
+            assert isinstance(e, ScheduleMismatchError)
+        assert "ReduceOp.SUM" in str(errs[0]) and "ReduceOp.MAX" in str(errs[0])
+
+    def test_missing_rank_times_out_into_diagnostic_not_hang(self):
+        v0, _ = _pair(every=2, timeout=0.5)
+
+        def run0():
+            v0.record(0, "all_reduce", (4, 1), "float32")
+            v0.record(1, "all_reduce", (4, 1), "float32")  # checkpoint: alone
+
+        errs = _run_ranks([run0])
+        assert isinstance(errs[0], ScheduleMismatchError)
+        msg = str(errs[0])
+        assert "rank(s) [1]" in msg
+        assert "all_reduce" in msg  # this rank's recent calls are shown
+
+    def test_world_one_never_verifies_through_store(self):
+        v = ScheduleVerifier(None, 0, 1, "driver", every=1)
+        for seq in range(5):
+            v.record(seq, "barrier", (), "")
+        assert v._window == [] and v._round == 0
+
+
+class TestScheduleMismatchFaultPoint:
+    def test_corrupt_rule_perturbs_only_matching_rank(self, monkeypatch):
+        monkeypatch.setenv("RANK", "1")
+        faults.install_plan(
+            [{"point": "schedule.mismatch", "rank": 1, "after": 2,
+              "action": "corrupt"}],
+            export_env=False,
+        )
+        try:
+            v = ScheduleVerifier(None, 1, 1, "g", every=100)
+            v.record(0, "all_reduce", (4,), "float32")
+            v.record(1, "all_reduce", (4,), "float32")  # 2nd call: perturbed
+            v.record(2, "all_reduce", (4,), "float32")
+            assert "<injected-divergence>" not in v._window[0]
+            assert "<injected-divergence>" in v._window[1]
+            assert "<injected-divergence>" not in v._window[2]
+            monkeypatch.setenv("RANK", "0")
+            w = ScheduleVerifier(None, 0, 1, "g", every=100)
+            w.record(0, "all_reduce", (4,), "float32")
+            w.record(1, "all_reduce", (4,), "float32")
+            assert all("<injected-divergence>" not in fp for fp in w._window)
+        finally:
+            faults.clear_plan()
+
+
+_GANG_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from pytorch_distributed_example_tpu.schedule import (
+    ScheduleMismatchError, ScheduleVerifier,
+)
+from pytorch_distributed_example_tpu.store import PrefixStore, TCPStore
+
+rank = int(os.environ["RANK"])
+port = int(sys.argv[1])
+mode = os.environ["MODE"]
+store = TCPStore("127.0.0.1", port, world_size=2, is_master=(rank == 0),
+                 timeout=30.0)
+v = ScheduleVerifier(PrefixStore("sched", store), rank, 2, "default_pg",
+                     every=4, timeout=5.0)
+rc = 0
+try:
+    for seq in range(8):
+        if mode == "skip" and rank == 1 and seq == 5:
+            continue  # the R001 bug at runtime: a rank-gated collective
+        v.record(seq, "all_reduce", (4, 1), "float32", "ReduceOp.SUM")
+    if mode == "skip" and rank == 1:
+        # park (as a rank blocked in a LATER collective would): rank 0's
+        # checkpoint must time out into a diagnostic, not wait forever
+        import time
+        time.sleep(8)
+    print(f"DONE {{rank}}")
+except ScheduleMismatchError as e:
+    print(f"MISMATCH {{rank}} {{e}}")
+    rc = 7
+# goodbye handshake: rank 0 hosts the store daemon and must not close it
+# while the peer may still be mid-store-op (the same reason
+# destroy_process_group runs a departure handshake)
+try:
+    store.set(f"bye/{{rank}}", b"1")
+    if rank == 0:
+        store.wait(["bye/0", "bye/1"], 15.0)
+except Exception:
+    pass
+store.close()
+sys.exit(rc)
+"""
+
+
+def _run_gang(tmp_path, mode, extra_env=None, timeout=40):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(_GANG_WORKER.format(repo=REPO)))
+    port = free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "RANK": str(rank),
+                "MODE": mode,
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script), str(port)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                env=env,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"schedule-check gang hung in mode {mode!r}")
+        outs.append(out.decode())
+    return procs, outs
+
+
+class TestScheduleCheckGang:
+    """Cross-process chaos proof over the real TCPStore (no jax in the
+    workers, so this stays in the quick tier)."""
+
+    def test_clean_schedule_agrees(self, tmp_path):
+        procs, outs = _run_gang(tmp_path, "clean")
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, out
+            assert f"DONE {r}" in out
+
+    def test_seeded_mismatch_is_diagnosed_on_both_ranks(self, tmp_path):
+        """The acceptance scenario: a seeded `schedule.mismatch` fault on
+        rank 1 turns into a ScheduleMismatchError on EVERY rank naming
+        the divergent collective — not a hang."""
+        plan = (
+            '[{"point": "schedule.mismatch", "rank": 1, "after": 6, '
+            '"action": "corrupt"}]'
+        )
+        procs, outs = _run_gang(
+            tmp_path, "clean", extra_env={"TDX_FAULT_PLAN": plan}
+        )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 7, out
+            assert "MISMATCH" in out
+            assert "all_reduce" in out
+            assert "divergen" in out  # names the divergence
+        # the perturbed call is named: rank 1's 6th record = seq 5, the
+        # 2nd call of the second checkpoint window
+        assert "#2" in outs[0]
+
+    def test_skipped_collective_times_out_into_named_diagnostic(self, tmp_path):
+        """Rank 1 skips one collective (the runtime shape of an R001 bug)
+        and parks: without the verifier rank 0 would wait forever inside
+        the transport; with it, rank 0 gets a diagnostic naming rank 1
+        within the checkpoint timeout."""
+        procs, outs = _run_gang(tmp_path, "skip")
+        p0, out0 = procs[0], outs[0]
+        assert p0.returncode == 7, out0
+        assert "MISMATCH 0" in out0
+        assert "rank(s) [1]" in out0
+        assert "did not reach" in out0
+
+
+@pytest.mark.slow
+class TestDispatchIntegrationMultiprocess:
+    """Full-stack proof: init_process_group across two real processes
+    (fake backend: dispatch plumbing without device collectives), a
+    TDX_FAULT_PLAN-seeded fingerprint divergence, ScheduleMismatchError
+    raised from inside `_dispatch` on both ranks."""
+
+    WORKER = textwrap.dedent(
+        """
+        import sys
+        rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", 1)
+        except AttributeError:
+            pass  # older jax: one CPU device per process is the default
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{jport}",
+            num_processes=world,
+            process_id=rank,
+        )
+
+        import numpy as np
+        import pytorch_distributed_example_tpu as tdx
+
+        pg = tdx.init_process_group(
+            backend="fake",
+            init_method=f"tcp://127.0.0.1:{sport}",
+            rank=rank,
+            world_size=world,
+        )
+        assert pg._sched is not None, "schedule verifier not armed"
+        t = tdx.DistTensor.from_process_local(
+            np.ones((1,), np.float32)
+        )
+        try:
+            for _ in range(6):
+                tdx.all_reduce(t)
+            print(f"CLEAN {rank}")
+        except tdx.ScheduleMismatchError as e:
+            print(f"MISMATCH {rank} {e}")
+            sys.exit(7)
+        """
+    )
+
+    def test_seeded_mismatch_raises_from_dispatch(self, tmp_path):
+        from tests._mp_util import worker_env
+
+        script = tmp_path / "worker.py"
+        script.write_text(self.WORKER)
+        jport, sport = free_port(), free_port()
+        procs = []
+        for rank in range(2):
+            env = worker_env()
+            env.update(
+                {
+                    "TDX_SCHEDULE_CHECK": "1",
+                    "TDX_SCHEDULE_CHECK_EVERY": "3",
+                    "TDX_SCHEDULE_CHECK_TIMEOUT_S": "10",
+                    "TDX_FAULT_PLAN": (
+                        '[{"point": "schedule.mismatch", "rank": 1, '
+                        '"after": 5, "action": "corrupt"}]'
+                    ),
+                    "RANK": str(rank),
+                }
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script), str(rank), "2",
+                     str(jport), str(sport)],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                    cwd=REPO,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multiproc schedule-check gang hung")
+            outs.append(out.decode())
+        for p, out in zip(procs, outs):
+            assert p.returncode == 7, out
+            assert "MISMATCH" in out
+            assert "all_reduce" in out
+
+
+class TestDriverModeWiring:
+    def test_dispatch_records_fingerprints_on_schedule_checked_group(
+        self, world, monkeypatch
+    ):
+        import pytorch_distributed_example_tpu as tdx
+
+        monkeypatch.setenv("TDX_SCHEDULE_CHECK", "1")
+        pg = tdx.new_group(backend="fake", group_desc="sched_wiring")
+        assert pg._sched is not None
+        assert pg._sched.world == 1  # driver mode: one caller, one schedule
+        # subgroup store must be incarnation-scoped: under an elastic
+        # restart with a persistent daemon, a bare "group_N" prefix would
+        # leak the dead incarnation's sched/objcnt/pgw keys into the new
+        # gang (spurious ScheduleMismatchError from stale checkpoints)
+        scope = tdx.distributed._world.scope
+        assert f"_gen{scope}" in pg.store.prefix
+        before = pg._sched._count
+        tdx.barrier(group=pg)
+        tdx.barrier(group=pg)
+        assert pg._sched._count == before + 2
+
+    def test_groups_without_env_have_no_verifier(self, world):
+        import pytorch_distributed_example_tpu as tdx
+
+        assert os.environ.get("TDX_SCHEDULE_CHECK", "0") != "1"
+        pg = tdx.new_group(backend="fake", group_desc="no_sched")
+        assert pg._sched is None
