@@ -1622,7 +1622,7 @@ def send(tensor, dst: int, group=None, tag: int = 0, *, src: Optional[int] = Non
     if src is None:
         raise ValueError("driver mode: send(...) needs src= (acting rank)")
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch(
+    out, work = g._dispatch(  # distlint: disable=R006 -- the permute Work drains through `out`'s data dependency in dt._set; the paired recv is the blocking side
         "send",
         dt.array,
         lambda: g.backend_impl.permute(dt.array, [(src, dst)]),

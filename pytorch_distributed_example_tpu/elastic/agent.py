@@ -168,6 +168,22 @@ class RunResult:
 
 
 _JOIN_KEY = "agent/join_waiting"  # NOT generation-namespaced: must survive re-forms
+_FATAL_KEY = "agent/fatal"
+
+
+def _mark_fatal(ctrl) -> None:
+    """Poison-pill the whole supervision tree: every agent polls
+    `_FATAL_KEY` and gives up. Deliberately neither generation-scoped nor
+    ever deleted — fatal is terminal for this store; no later generation
+    may form on it."""
+    ctrl.set(_FATAL_KEY, b"1")  # distlint: disable=R007 -- terminal poison-pill: outliving every generation is the point
+
+def _join_add(store, amount: int) -> int:
+    """All access to the join counter. The key is value-managed, not
+    key-managed: admits subtract exactly what they consumed, so a nonzero
+    remainder is LIVE state (joiners queued for the next generation) —
+    deleting the key would silently drop them."""
+    return store.add(_JOIN_KEY, amount)  # distlint: disable=R007 -- value-managed counter; admits decrement what they consume
 
 
 def request_join(master_addr: str, master_port: int, timeout: float = 30.0) -> int:
@@ -187,7 +203,7 @@ def request_join(master_addr: str, master_port: int, timeout: float = 30.0) -> i
         )
     s = TCPStore(master_addr, master_port, is_master=False, timeout=timeout)
     try:
-        return s.add(_JOIN_KEY, 1)
+        return _join_add(s, 1)
     finally:
         s.close()
 
@@ -422,7 +438,9 @@ class LocalElasticAgent:
                 )
                 if ctrl is not None:
                     try:
-                        ctrl.set("agent/restart_gen", str(self.restart_count + 1))
+                        # the generation POINTER itself: overwritten (never
+                        # appended) each re-form, so it cannot accumulate
+                        ctrl.set("agent/restart_gen", str(self.restart_count + 1))  # distlint: disable=R007 -- single overwritten pointer key, the incarnation scope others hang off
                     except Exception:
                         pass  # store host may be gone; barrier will decide
                 return WorkerState.FAILED
@@ -438,7 +456,7 @@ class LocalElasticAgent:
                 g = self._peek(ctrl, "agent/restart_gen")
                 if g is not None and int(g) > self.restart_count:
                     return WorkerState.FAILED  # peer-signaled restart
-                if self._peek(ctrl, "agent/fatal") is not None:
+                if self._peek(ctrl, _FATAL_KEY) is not None:
                     return WorkerState.FAILED
 
     def _join_waiting(self) -> int:
@@ -447,7 +465,7 @@ class LocalElasticAgent:
         if store is None:
             return 0
         try:
-            return store.add(_JOIN_KEY, 0)
+            return _join_add(store, 0)
         except Exception:
             return 0
 
@@ -459,11 +477,11 @@ class LocalElasticAgent:
         if store is None:
             return survivors
         try:
-            waiting = store.add(_JOIN_KEY, 0)
+            waiting = _join_add(store, 0)
             new = min(survivors + waiting, self.spec.nproc_per_node)
             admitted = new - survivors
             if admitted:
-                store.add(_JOIN_KEY, -admitted)
+                _join_add(store, -admitted)
             return new
         except Exception:
             return survivors
@@ -483,7 +501,7 @@ class LocalElasticAgent:
             return "fatal"
         deadline = time.monotonic() + self.spec.peer_done_timeout_s
         while time.monotonic() < deadline:
-            if self._peek(ctrl, "agent/fatal") is not None:
+            if self._peek(ctrl, _FATAL_KEY) is not None:
                 return "fatal"
             g = self._peek(ctrl, "agent/restart_gen")
             if g is not None and int(g) > self.restart_count:
@@ -516,7 +534,7 @@ class LocalElasticAgent:
                 return "done"
             time.sleep(self.spec.monitor_interval_s)
         try:
-            ctrl.set("agent/fatal", b"1")
+            _mark_fatal(ctrl)
         except Exception:
             pass
         return "fatal"
@@ -528,12 +546,12 @@ class LocalElasticAgent:
         ctrl = self._control()
         if ctrl is None:
             return True
-        if self._peek(ctrl, "agent/fatal") is not None:
+        if self._peek(ctrl, _FATAL_KEY) is not None:
             return False
         g = self._peek(ctrl, "agent/restart_gen")
         target = max(int(g) if g is not None else 0, self.restart_count + 1)
         if target > self.spec.max_restarts:
-            ctrl.set("agent/fatal", b"1")
+            _mark_fatal(ctrl)
             return False
         self.restart_count = target
         ctrl.set(f"agent/gen{target}/ready/{self.spec.node_rank}", b"1")
@@ -546,9 +564,9 @@ class LocalElasticAgent:
                 120.0,
             )
         except Exception:
-            ctrl.set("agent/fatal", b"1")
+            _mark_fatal(ctrl)
             return False
-        return self._peek(ctrl, "agent/fatal") is None
+        return self._peek(ctrl, _FATAL_KEY) is None
 
     # -- node-level elastic (torchelastic --nnodes=MIN:MAX) ----------------
     def abort(self) -> None:
@@ -860,7 +878,7 @@ class LocalElasticAgent:
                 self.restart_count = target
                 return "retry"
             try:
-                ctrl.set("agent/fatal", b"1")
+                _mark_fatal(ctrl)
             except Exception:
                 pass
             return "fatal"
@@ -895,7 +913,7 @@ class LocalElasticAgent:
                 return WorkerState.FAILED
             if all(c == 0 for c in codes.values()):
                 return WorkerState.SUCCEEDED
-            if self._peek(ctrl, "agent/fatal") is not None:
+            if self._peek(ctrl, _FATAL_KEY) is not None:
                 return WorkerState.FAILED
             if self._peeked_gen(ctrl) > self.restart_count:
                 return WorkerState.FAILED  # peer-signaled membership change
@@ -942,7 +960,7 @@ class LocalElasticAgent:
         while time.monotonic() < deadline:
             self._check_abort()
             self._heartbeat(ctrl)
-            if self._peek(ctrl, "agent/fatal") is not None:
+            if self._peek(ctrl, _FATAL_KEY) is not None:
                 return "fatal"
             if self._peeked_gen(ctrl) > self.restart_count:
                 return "restart"
@@ -987,7 +1005,7 @@ class LocalElasticAgent:
                 return "done"
             time.sleep(self.spec.monitor_interval_s)
         try:
-            ctrl.set("agent/fatal", b"1")
+            _mark_fatal(ctrl)
         except Exception:
             pass
         return "fatal"
@@ -1067,7 +1085,7 @@ class LocalElasticAgent:
                         target = g
                         break
                     if (
-                        self._peek(ctrl, "agent/fatal") is not None
+                        self._peek(ctrl, _FATAL_KEY) is not None
                         or time.monotonic() > join_deadline
                     ):
                         return RunResult(
@@ -1105,7 +1123,7 @@ class LocalElasticAgent:
             self._heartbeat(ctrl)
             self._stop_workers()
             self._heartbeat(ctrl)
-            if self._peek(ctrl, "agent/fatal") is not None:
+            if self._peek(ctrl, _FATAL_KEY) is not None:
                 return RunResult(
                     WorkerState.FAILED, self.restart_count, self._codes()
                 )
@@ -1117,7 +1135,7 @@ class LocalElasticAgent:
                 self._failure_restarts += 1
                 if self._failure_restarts > self.spec.max_restarts:
                     try:
-                        ctrl.set("agent/fatal", b"1")
+                        _mark_fatal(ctrl)
                     except Exception:
                         pass
                     return RunResult(

@@ -28,7 +28,8 @@ via `install_plan()`. Each rule:
 Named injection points wired in this package:
 
     store.get / store.set / store.add / store.check / store.compare_set /
-    store.delete / store.wait / store.connect      (store client ops)
+    store.delete / store.num_keys / store.ping /
+    store.wait / store.connect                     (store client ops)
     rendezvous.join                                (rendezvous handlers)
     p2p.connect / p2p.send                         (direct data plane)
     collective.dispatch                            (eager collective path)
@@ -83,6 +84,7 @@ __all__ = [
     "FaultRule",
     "FaultPlan",
     "FaultTimeout",
+    "KNOWN_POINTS",
     "fire",
     "install_plan",
     "clear_plan",
@@ -90,6 +92,37 @@ __all__ = [
 ]
 
 _ENV_VAR = "TDX_FAULT_PLAN"
+
+# The registry of injection points wired in this package — the STATIC
+# contract between fault plans and `fire()` call sites, enforced at lint
+# time: distlint's R008 validates every fire() literal, fault-plan dict,
+# and embedded JSON plan string against this frozen set (globs in plans
+# must match at least one entry), so a typo'd point can never make a
+# chaos test pass vacuously. Keep it in sync with the docstring above.
+# There is deliberately NO runtime validation or extension hook: plans
+# may name arbitrary points (unit tests fire synthetic ones), and R008
+# only reads this literal.
+KNOWN_POINTS = frozenset({
+    "store.set",
+    "store.get",
+    "store.add",
+    "store.check",
+    "store.compare_set",
+    "store.delete",
+    "store.num_keys",
+    "store.ping",
+    "store.wait",
+    "store.connect",
+    "rendezvous.join",
+    "p2p.connect",
+    "p2p.send",
+    "collective.dispatch",
+    "schedule.mismatch",
+    "agent.heartbeat",
+    "checkpoint.write",
+    "checkpoint.finalize",
+    "train.step",
+})
 
 
 class FaultTimeout(DistError, TimeoutError):

@@ -184,6 +184,7 @@ class P2PPlane:
         self._cond = threading.Condition()
         self._waiting = 0  # recv threads currently blocked empty-handed
         self._closed = False
+        self._published: Optional[bytes] = None  # our ep/<rank> payload
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -210,7 +211,8 @@ class P2PPlane:
                 self._accept_thread = t
             except OSError:
                 self.listening = False  # publish "none"; peers fall back
-        self.store.set(f"ep/{self.rank}", ep)
+        self.store.set(f"ep/{self.rank}", ep)  # distlint: disable=R007 -- close() atomically tombstones to _NONE_EP via compare_set; deletion would make late peers BLOCK instead of reading "opted out"
+        self._published = ep  # close() tombstones only our own payload
         return self
 
     def close(self) -> None:
@@ -219,6 +221,23 @@ class P2PPlane:
                 return
             self._closed = True
             self._cond.notify_all()
+        # unpublish the endpoint (R007 lifecycle): even on a store whose
+        # caller forgot the incarnation PrefixStore, a cleanly-closed
+        # plane must not leave a dialable-looking endpoint behind. ONE
+        # atomic compare_set tombstones the key only while it still holds
+        # OUR payload — a successor generation that already re-published
+        # this rank's key mismatches `expected` and is left alone, and a
+        # dead store costs at most the single op's deadline (no
+        # check/get/delete chain to stall through). Peers that read the
+        # tombstone see "rank opted out" (_NONE_EP) instead of blocking.
+        try:
+            if self._published is not None and self._published != _NONE_EP:
+                self.store.compare_set(
+                    f"ep/{self.rank}", self._published, _NONE_EP
+                )
+        except Exception:
+            # best-effort: the store host is often already gone at teardown
+            logger.debug("p2p endpoint unpublish failed", exc_info=True)
         for s in [self._listener] + list(self._out.values()) + self._in_conns:
             if s is not None:
                 try:

@@ -509,12 +509,17 @@ class TCPStore(Store):
             self._sock = self._connect()
         # worker-join handshake (torch TCPStore wait_for_workers semantics):
         # every worker registers on connect; the master's constructor blocks
-        # until world_size-1 workers have joined.
+        # until world_size-1 workers have joined. The counter key is scoped
+        # by the elastic restart generation (TDX_RESTART_COUNT, inherited by
+        # respawned workers) so a persistent agent-hosted daemon never
+        # counts generation N-1's joins against generation N (R007).
+        gen = os.environ.get("TDX_RESTART_COUNT", "0") or "0"
+        join_key = f"__init/worker_count/gen{gen}"
         if world_size > 0 and not is_master:
-            self.add("__init/worker_count", 1)
+            self.add(join_key, 1)
         if is_master and wait_for_workers and world_size > 1:
             deadline = time.monotonic() + self.timeout
-            while int(self._call(_CMD_ADD, "__init/worker_count", b"0").decode()) < world_size - 1:
+            while int(self._call(_CMD_ADD, join_key, b"0").decode()) < world_size - 1:
                 if time.monotonic() > deadline:
                     raise StoreTimeoutError(
                         f"timed out waiting for {world_size - 1} workers to join"

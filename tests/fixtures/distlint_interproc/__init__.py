@@ -1,0 +1,9 @@
+"""Deliberately-buggy corpus for the interprocedural distlint tests.
+
+Every file here exists to exercise one call-graph-builder edge (cycles,
+decorators, self-method resolution, re-exports, multi-hop effect
+propagation) and most carry INTENTIONAL findings — which is why
+pyproject's [tool.distlint] excludes this directory from the self-lint.
+"""
+
+from .outer import entry  # re-export: resolving pkg.entry must chase this

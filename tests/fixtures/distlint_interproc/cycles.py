@@ -1,0 +1,18 @@
+"""Mutually-recursive helpers: the effect fixed point must terminate and
+both participants must summarize as may-issue-collective."""
+
+
+def ping(t, dist, depth):
+    if depth <= 0:
+        dist.barrier()
+        return
+    pong(t, dist, depth - 1)
+
+
+def pong(t, dist, depth):
+    ping(t, dist, depth)
+
+
+def gated_cycle_call(t, dist):
+    if dist.get_rank() == 0:
+        pong(t, dist, 3)

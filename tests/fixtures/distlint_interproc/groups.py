@@ -1,0 +1,19 @@
+"""Interprocedural R002 (swallowed effectful call) and R004 (group not
+forwarded to a group-taking effectful helper) shapes."""
+
+from .middle import sync_buffers
+
+
+def swallow(t, dist, log):
+    try:
+        sync_buffers(t, dist)
+    except Exception:
+        log.warning("oops")  # swallows and continues
+
+
+def helper(t, dist, group=None):
+    dist.all_reduce(t, group=group)
+
+
+def drops_group(t, dist, group):
+    helper(t, dist)

@@ -1,0 +1,5 @@
+"""Hop 2: the function that actually issues the collective."""
+
+
+def flush(t, dist):
+    dist.all_reduce(t)

@@ -1,0 +1,17 @@
+"""Method resolution through `self`, including a base-class method: the
+rank-gated call to `self._flush_buckets` must resolve through the MRO to
+`_ReducerBase._all_reduce_flat` and flag R001."""
+
+
+class _ReducerBase:
+    def _all_reduce_flat(self, t, dist):
+        dist.all_reduce(t)
+
+
+class Reducer(_ReducerBase):
+    def _flush_buckets(self, t, dist):
+        self._all_reduce_flat(t, dist)
+
+    def maybe_flush(self, t, dist):
+        if dist.get_rank() == 0:
+            self._flush_buckets(t, dist)

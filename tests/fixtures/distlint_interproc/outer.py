@@ -1,0 +1,10 @@
+"""The rank-gated caller: reaches all_reduce only through TWO helper hops
+(outer.entry -> middle.sync_buffers -> inner.flush). distlint must flag
+the call below as R001 with the full caller→callee trace."""
+
+from .middle import sync_buffers
+
+
+def entry(t, dist):
+    if dist.get_rank() == 0:
+        sync_buffers(t, dist)

@@ -1,0 +1,12 @@
+"""Interprocedural R003: a helper that blocks on the store, called inside
+an async-launch window."""
+
+
+def read_flag(store):
+    return store.get("flag")
+
+
+def window(t, dist, store):
+    w = dist.all_reduce(t, async_op=True)
+    read_flag(store)
+    w.wait()

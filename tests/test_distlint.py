@@ -1,6 +1,8 @@
-"""distlint unit fixtures: every rule R001-R005 has at least one positive
-(flagged) and one negative (clean) case, plus suppression and config
-coverage. Pure AST analysis — no jax, quick tier."""
+"""distlint unit fixtures: every rule R001-R010 has at least one positive
+(flagged) and one negative (clean) case, plus suppression, severity,
+baseline, SARIF and --fix coverage. Pure AST analysis — no jax, quick
+tier."""
+# distlint: disable-file=R008 -- the R008 POSITIVE fixtures embed deliberately-bogus point names inside fixture strings
 
 import json
 import subprocess
@@ -9,17 +11,25 @@ import textwrap
 
 from pytorch_distributed_example_tpu.tools.distlint import (
     LintConfig,
+    apply_baseline,
+    apply_fixes,
+    baseline_entries,
     lint_source,
+    load_baseline,
     load_config,
     main,
+    render_sarif,
+    write_baseline,
 )
 
 from tests._mp_util import REPO
 
+_POINTS = {"store.get", "train.step", "collective.dispatch"}
 
-def _rules(src, path="x.py", dispatch_path=False):
+
+def _rules(src, path="x.py", dispatch_path=False, **kw):
     findings = lint_source(
-        textwrap.dedent(src), path, dispatch_path=dispatch_path
+        textwrap.dedent(src), path, dispatch_path=dispatch_path, **kw
     )
     return [(f.rule, f.suppressed) for f in findings]
 
@@ -269,11 +279,25 @@ class TestSuppressions:
         assert rules == [("R001", True)]
 
     def test_wrong_rule_does_not_suppress(self):
+        # the R001 stays active AND the mismatched R002 suppression is
+        # itself reported stale (R009)
         assert _active(
             """
             def f(t, dist):
                 if dist.get_rank() == 0:
                     dist.barrier()  # distlint: disable=R002 -- wrong rule
+            """
+        ) == ["R001", "R009"]
+
+    def test_suppression_inside_string_literal_is_inert(self):
+        # not a comment token: neither suppresses nor goes stale
+        assert _active(
+            """
+            DOC = "use # distlint: disable=R001 -- like this"
+
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()
             """
         ) == ["R001"]
 
@@ -344,3 +368,628 @@ class TestConfigAndCli:
         )
         assert out.returncode == 0
         assert "R001" in out.stdout or "collective" in out.stdout
+
+
+class TestR006WorkLifecycle:
+    def test_positive_discarded_async_launch(self):
+        assert _active(
+            """
+            def f(t, dist):
+                dist.all_reduce(t, async_op=True)
+            """
+        ) == ["R006"]
+
+    def test_positive_dead_work_name(self):
+        assert _active(
+            """
+            def f(t, dist):
+                work = dist.all_reduce(t, async_op=True)
+                return t
+            """
+        ) == ["R006"]
+
+    def test_negative_waited_returned_or_handed_off(self):
+        assert _active(
+            """
+            def f(t, dist, works):
+                w = dist.all_reduce(t, async_op=True)
+                w.wait()
+                dist.all_reduce(t, async_op=True).wait()
+                works.append(dist.all_reduce(t, async_op=True))
+                return dist.all_reduce(t, async_op=True)
+            """
+        ) == []
+
+    def test_negative_dispatch_tuple_work_slot_used(self):
+        assert _active(
+            """
+            def f(g, arr, fn):
+                out, work = g._dispatch("op", arr, fn)
+                work.wait()
+                return out
+            """
+        ) == []
+
+    def test_positive_dispatch_tuple_work_slot_dead(self):
+        assert _active(
+            """
+            def f(g, arr, fn):
+                out, work = g._dispatch("op", arr, fn)
+                return out
+            """
+        ) == ["R006"]
+
+    def test_negative_coalescing_manager_captures(self):
+        assert _active(
+            """
+            def f(t, dist, cm_factory):
+                with coalescing_manager(async_ops=True) as cm:
+                    dist.all_reduce(t, async_op=True)
+                cm.wait()
+            """
+        ) == []
+
+
+class TestR007StoreKeyLifecycle:
+    def test_positive_unscoped_undeleted_set(self):
+        assert _active(
+            """
+            def f(store):
+                store.set("agent/flag", b"1")
+            """,
+            store_lifecycle=True,
+        ) == ["R007"]
+
+    def test_negative_incarnation_scoped_field(self):
+        assert _active(
+            """
+            def f(store, gen, me):
+                store.set(f"done/gen{gen}/{me}", b"1")
+            """,
+            store_lifecycle=True,
+        ) == []
+
+    def test_negative_scoping_namespace_segment(self):
+        # the field is named `target` but rides in a .../gen{...} segment
+        assert _active(
+            """
+            def f(store, target, me):
+                store.set(f"agent/gen{target}/ready/{me}", b"1")
+            """,
+            store_lifecycle=True,
+        ) == []
+
+    def test_negative_deleted_in_same_file(self):
+        assert _active(
+            """
+            def f(store, n):
+                store.set(f"join/{n}", b"1")
+
+            def g(store, n):
+                store.delete_key(f"join/{n}")
+            """,
+            store_lifecycle=True,
+        ) == []
+
+    def test_negative_non_store_receiver_and_dynamic_key(self):
+        assert _active(
+            """
+            def f(seen, store, key):
+                seen.add("agent/flag")
+                store.set(key, b"1")
+            """,
+            store_lifecycle=True,
+        ) == []
+
+    def test_module_constant_key_resolves(self):
+        assert _active(
+            """
+            _KEY = "agent/flag"
+
+            def f(store):
+                store.add(_KEY, 1)
+            """,
+            store_lifecycle=True,
+        ) == ["R007"]
+
+    def test_off_outside_lifecycle_paths(self):
+        assert _active(
+            """
+            def f(store):
+                store.set("agent/flag", b"1")
+            """,
+            store_lifecycle=False,
+        ) == []
+
+
+class TestR008FaultPoints:
+    def test_positive_fire_literal_and_plan_dict(self):
+        assert _active(
+            """
+            from pytorch_distributed_example_tpu import faults
+
+            def f():
+                faults.fire("store.gett")
+                faults.install_plan([{"point": "nope.*", "action": "reset"}])
+            """,
+            fault_points=_POINTS,
+        ) == ["R008", "R008"]
+
+    def test_positive_embedded_json_plan_string(self):
+        assert _active(
+            """
+            PLAN = '[{"point": "trian.step", "action": "crash"}]'
+            """,
+            fault_points=_POINTS,
+        ) == ["R008"]
+
+    def test_negative_known_points_and_globs(self):
+        assert _active(
+            """
+            from pytorch_distributed_example_tpu import faults
+
+            def f():
+                faults.fire("store.get")
+                faults.install_plan([{"point": "store.*", "action": "reset"}])
+
+            PLAN = '[{"point": "train.step", "action": "crash"}]'
+            """,
+            fault_points=_POINTS,
+        ) == []
+
+    def test_no_registry_no_findings(self):
+        assert _active(
+            """
+            def f(faults):
+                faults.fire("totally.unknown")
+            """,
+            fault_points=None,
+        ) == []
+
+
+class TestR009StaleSuppressions:
+    def test_positive_line_and_file_wide(self):
+        assert _active(
+            """
+            # distlint: disable-file=R003 -- nothing here blocks anything
+            def f(t, dist):
+                dist.all_reduce(t)  # distlint: disable=R001 -- stale: no gate any more
+            """
+        ) == ["R009", "R009"]
+
+    def test_negative_matching_suppression_not_stale(self):
+        rules = _rules(
+            """
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()  # distlint: disable=R001 -- intentional
+            """
+        )
+        assert rules == [("R001", True)]
+
+    def test_r009_suppressible_on_its_own_line(self):
+        assert _active(
+            """
+            def f(t, dist):
+                dist.all_reduce(t)  # distlint: disable=R001,R009 -- kept while the gate is behind a feature flag
+            """
+        ) == []
+
+
+class TestR010RankLocalLoops:
+    def test_positive_for_over_local_collection(self):
+        assert _active(
+            """
+            def f(local_batches, dist):
+                for b in local_batches:
+                    dist.all_reduce(b)
+            """
+        ) == ["R010"]
+
+    def test_positive_range_of_rank(self):
+        assert _active(
+            """
+            def f(t, dist):
+                for _ in range(dist.get_rank()):
+                    dist.barrier()
+            """
+        ) == ["R010"]
+
+    def test_positive_while_over_local_state(self):
+        assert _active(
+            """
+            def f(my_pending, t, dist):
+                while my_pending > 0:
+                    dist.all_reduce(t)
+                    my_pending -= 1
+            """
+        ) == ["R010"]
+
+    def test_negative_world_uniform_loop(self):
+        assert _active(
+            """
+            def f(buckets, t, dist):
+                for b in buckets:
+                    dist.all_reduce(b)
+                for _ in range(10):
+                    dist.barrier()
+            """
+        ) == []
+
+
+class TestSeverityConfig:
+    def test_warning_and_off(self):
+        src = """
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)
+        """
+        cfg = LintConfig(severity={"R001": "warning"})
+        fs = lint_source(textwrap.dedent(src), "x.py", config=cfg)
+        assert [(f.rule, f.severity) for f in fs] == [("R001", "warning")]
+        cfg = LintConfig(severity={"R001": "off"})
+        assert lint_source(textwrap.dedent(src), "x.py", config=cfg) == []
+
+    def test_bad_severity_value_rejected(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.distlint.severity]\nR001 = 'loud'\n"
+        )
+        import pytest
+
+        from pytorch_distributed_example_tpu.tools.distlint import load_config
+
+        with pytest.raises(ValueError):
+            load_config(str(tmp_path))
+
+
+class TestBaselineRatchet:
+    SRC = (
+        "def f(t, dist):\n"
+        "    if dist.get_rank() == 0:\n"
+        "        dist.all_reduce(t)\n"
+        "    if dist.get_rank() == 1:\n"
+        "        dist.barrier()\n"
+    )
+
+    def _findings(self):
+        return lint_source(self.SRC, "mod.py")
+
+    def test_baseline_grandfathers_and_flags_new(self, tmp_path):
+        fs = self._findings()
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), fs)
+        doc = load_baseline(str(bl))
+        assert len(doc["findings"]) == 2
+        # same findings again: all grandfathered
+        new, matched, stale = apply_baseline(self._findings(), doc)
+        assert (len(new), len(matched), len(stale)) == (0, 2, 0)
+        # a NEW finding is not absorbed
+        fs3 = lint_source(
+            self.SRC + "    if dist.get_rank() == 2:\n        dist.reduce(t, 0)\n",
+            "mod.py",
+        )
+        new, matched, stale = apply_baseline(fs3, doc)
+        assert len(new) == 1 and new[0].line == 7 and len(matched) == 2
+
+    def test_fingerprints_survive_line_drift(self, tmp_path):
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), self._findings())
+        shifted = lint_source("x = 1\ny = 2\n" + self.SRC, "mod.py")
+        new, matched, stale = apply_baseline(shifted, load_baseline(str(bl)))
+        assert (len(new), len(matched), len(stale)) == (0, 2, 0)
+
+    def test_stale_entries_reported(self, tmp_path):
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), self._findings())
+        # de-rank the second gate: its finding disappears, leaving the
+        # baseline entry stale
+        fixed = lint_source(
+            self.SRC.replace("dist.get_rank() == 1", "step == 1"), "mod.py"
+        )
+        new, matched, stale = apply_baseline(fixed, load_baseline(str(bl)))
+        assert len(stale) == 1 and len(new) == 0
+
+    def test_ratchet_refuses_growth(self, tmp_path):
+        import pytest
+
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), self._findings()[:1])
+        with pytest.raises(ValueError, match="ratchet"):
+            write_baseline(str(bl), self._findings())
+        # but shrink (and equal) is always fine
+        write_baseline(str(bl), self._findings()[:1])
+        write_baseline(str(bl), [])
+
+    def test_suppressed_and_warnings_stay_out_of_baseline(self):
+        cfg = LintConfig(severity={"R001": "warning"})
+        fs = lint_source(self.SRC, "mod.py", config=cfg)
+        assert baseline_entries(fs) == []
+
+
+class TestSarif:
+    def test_sarif_shape_and_baseline_state(self, tmp_path):
+        fs = lint_source(TestBaselineRatchet.SRC, "mod.py")
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), fs[:1])
+        try:
+            write_baseline(str(bl), fs)
+        except ValueError:
+            pass
+        fs = lint_source(
+            TestBaselineRatchet.SRC, "mod.py"
+        )
+        apply_baseline(fs, load_baseline(str(bl)))
+        doc = render_sarif(fs)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert any(r["id"] == "R010" for r in run["tool"]["driver"]["rules"])
+        states = sorted(r["baselineState"] for r in run["results"])
+        assert states == ["new", "unchanged"]
+        res = run["results"][0]
+        assert res["locations"][0]["physicalLocation"]["artifactLocation"]["uri"] == "mod.py"
+        assert res["partialFingerprints"]["distlint/v1"]
+
+
+class TestR004Autofix:
+    def test_fix_forwards_group_with_diff_then_write(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, group, dist):\n"
+            "    dist.all_reduce(t)\n"
+            "    dist.broadcast(\n"
+            "        t,\n"
+            "        0,\n"
+            "    )\n"
+        )
+        from pytorch_distributed_example_tpu.tools.distlint import lint_file
+
+        fs = lint_file(str(bad), LintConfig(), root=str(tmp_path))
+        assert [f.rule for f in fs] == ["R004", "R004"]
+        # dry run: diff printed, file untouched
+        n, diff = apply_fixes(fs, root=str(tmp_path), dry_run=True)
+        assert n == 2
+        assert "+    dist.all_reduce(t, group=group)" in diff
+        assert bad.read_text().count("group=group") == 0
+        # real run
+        n, _ = apply_fixes(fs, root=str(tmp_path), dry_run=False)
+        assert n == 2
+        fixed = bad.read_text()
+        assert "dist.all_reduce(t, group=group)" in fixed
+        assert "        group=group,\n" not in fixed  # multi-line: appended at paren
+        assert lint_file(str(bad), LintConfig(), root=str(tmp_path)) == []
+
+    def test_fix_handles_trailing_comma_and_empty_args(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, process_group, dist):\n"
+            "    dist.barrier()\n"
+            "    dist.all_reduce(t,)\n"
+        )
+        from pytorch_distributed_example_tpu.tools.distlint import lint_file
+
+        fs = lint_file(str(bad), LintConfig(), root=str(tmp_path))
+        n, _ = apply_fixes(fs, root=str(tmp_path))
+        assert n == 2
+        src = bad.read_text()
+        assert "dist.barrier(group=process_group)" in src
+        assert "dist.all_reduce(t, group=process_group)" in src
+        assert lint_file(str(bad), LintConfig(), root=str(tmp_path)) == []
+
+    def test_cli_fix_diff_mode(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(t, group, dist):\n    dist.all_reduce(t)\n")
+        rc = main([str(bad), "--root", str(tmp_path), "--no-config", "--fix-diff"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "group=group" in out
+        assert "group=group" not in bad.read_text()
+
+    def test_fix_survives_trailing_comment_after_comma(self, tmp_path):
+        # a comment (or a '#' inside a string) after the last argument
+        # must not fool the separator choice into emitting ", ," —
+        # review finding: the naive rstrip walk produced a SyntaxError
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, group, dist):\n"
+            "    dist.all_reduce(\n"
+            "        t,  # reduce in place\n"
+            "    )\n"
+            "    dist.broadcast(\n"
+            '        "a#b",\n'
+            "        0\n"
+            "    )\n"
+        )
+        from pytorch_distributed_example_tpu.tools.distlint import lint_file
+
+        fs = lint_file(str(bad), LintConfig(), root=str(tmp_path))
+        n, _ = apply_fixes(fs, root=str(tmp_path))
+        assert n == 2
+        import ast as _ast
+
+        src = bad.read_text()
+        _ast.parse(src)  # the rewrite must stay valid Python
+        assert "group=group)" in src
+        assert lint_file(str(bad), LintConfig(), root=str(tmp_path)) == []
+
+
+class TestReviewRegressions:
+    def test_severity_off_does_not_stale_its_suppressions(self):
+        src = """
+            def f(t, dist):
+                if dist.get_rank() == 0:
+                    dist.barrier()  # distlint: disable=R001 -- intentional
+            """
+        cfg = LintConfig(severity={"R001": "off"})
+        fs = lint_source(textwrap.dedent(src), "x.py", config=cfg)
+        # rule off: no R001, and its suppression is skipped, not stale
+        assert fs == []
+
+    def test_update_baseline_refuses_swapped_findings(self, tmp_path):
+        # fixing one finding must not buy a slot for a NEW one: identity,
+        # not count (review finding: the count check let swaps through)
+        import pytest
+
+        bl = tmp_path / "bl.json"
+        src_a = "def f(t, dist):\n    if dist.get_rank() == 0:\n        dist.barrier()\n"
+        src_b = "def f(t, dist):\n    if dist.get_rank() == 0:\n        dist.reduce(t, 0)\n"
+        write_baseline(str(bl), lint_source(src_a, "mod.py"))
+        with pytest.raises(ValueError, match="ratchet"):
+            write_baseline(str(bl), lint_source(src_b, "mod.py"))
+
+    def test_direct_dispatch_is_a_collective(self):
+        # review finding: the raw dispatch primitive itself was blind to
+        # R001 while one-helper-hop-away calls were flagged
+        assert _active(
+            """
+            def f(g, arr, fn):
+                if g.rank() == 0:
+                    out, work = g._dispatch("barrier", arr, fn)
+                    work.wait()
+            """
+        ) == ["R001"]
+
+    def test_sarif_empty_baseline_marks_new(self):
+        # review finding: with an EMPTY baseline nothing was baselined,
+        # auto-detection turned baseline mode off, and consumers
+        # filtering baselineState=='new' saw zero findings
+        fs = lint_source(
+            "def f(t, dist):\n    if dist.get_rank() == 0:\n        dist.barrier()\n",
+            "mod.py",
+        )
+        new, matched, stale = apply_baseline(fs, {"findings": []})
+        assert len(new) == 1 and not matched
+        doc = render_sarif(fs, baseline_mode=True)
+        assert [r["baselineState"] for r in doc["runs"][0]["results"]] == ["new"]
+
+    def test_lint_paths_scope_respects_paths_with_broad_project(self, tmp_path):
+        # review finding: a supplied project made lint_paths lint
+        # EVERYTHING in it, ignoring the requested paths
+        from pytorch_distributed_example_tpu.tools.distlint import (
+            build_project,
+            lint_paths,
+        )
+
+        (tmp_path / "a.py").write_text(
+            "def f(t, dist):\n    if dist.get_rank() == 0:\n        dist.barrier()\n"
+        )
+        (tmp_path / "b.py").write_text(
+            "def g(t, dist):\n    if dist.get_rank() == 0:\n        dist.barrier()\n"
+        )
+        cfg = LintConfig(paths=["a.py", "b.py"])
+        proj = build_project(["a.py", "b.py"], root=str(tmp_path), config=cfg)
+        fs = lint_paths(["a.py"], root=str(tmp_path), config=cfg, project=proj)
+        assert {f.path for f in fs} == {"a.py"}
+
+    def test_while_break_does_not_gate_following_collectives(self):
+        # review finding: break/continue exit the while ITSELF — all
+        # ranks converge on the statements after it
+        assert _active(
+            """
+            def f(t, dist):
+                while dist.get_rank() == 0:
+                    t += 1
+                    break
+                dist.all_reduce(t)
+            """
+        ) == []
+
+    def test_while_return_still_gates(self):
+        assert _active(
+            """
+            def f(t, dist):
+                while dist.get_rank() != 0:
+                    return None
+                dist.all_reduce(t)
+            """
+        ) == ["R001"]
+
+    def test_sarif_warnings_carry_no_baseline_state(self):
+        cfg = LintConfig(severity={"R001": "warning"})
+        fs = lint_source(
+            "def f(t, dist):\n    if dist.get_rank() == 0:\n        dist.barrier()\n",
+            "mod.py",
+            config=cfg,
+        )
+        apply_baseline(fs, {"findings": []})
+        doc = render_sarif(fs, baseline_mode=True)
+        res = doc["runs"][0]["results"]
+        assert [r["level"] for r in res] == ["warning"]
+        assert all("baselineState" not in r for r in res)
+
+    def test_fix_skips_double_star_kwargs(self, tmp_path):
+        # review finding: **kw may already carry group=; appending the
+        # keyword would raise duplicate-keyword TypeError at runtime
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, group, dist, **kw):\n    dist.all_reduce(t, **kw)\n"
+        )
+        from pytorch_distributed_example_tpu.tools.distlint import lint_file
+
+        fs = lint_file(str(bad), LintConfig(), root=str(tmp_path))
+        assert [f.rule for f in fs] == ["R004"]  # still flagged...
+        n, _ = apply_fixes(fs, root=str(tmp_path))
+        assert n == 0  # ...but not auto-fixed
+        assert "group=group" not in bad.read_text()
+
+    def test_work_waited_inside_closure_is_live(self):
+        # review finding: a deferred wait through a lambda/closure is a
+        # hand-off, not a dead name
+        assert _active(
+            """
+            def f(t, dist, defer):
+                w = dist.all_reduce(t, async_op=True)
+                defer(lambda: w.wait())
+            """
+        ) == []
+
+    def test_scope_field_substrings_do_not_scope(self):
+        # review finding: 'agent_id' contains 'gen' but is NOT an
+        # incarnation field; anchored matching must still flag the leak
+        assert _active(
+            """
+            def f(store, agent_id):
+                store.set(f"lock/{agent_id}", b"1")
+            """,
+            store_lifecycle=True,
+        ) == ["R007"]
+
+    def test_work_waited_inside_nested_def_is_live(self):
+        # review finding: top-level nested defs were skipped by the
+        # liveness load counter (only lambdas were seen)
+        assert _active(
+            """
+            def f(t, dist, register):
+                w = dist.all_reduce(t, async_op=True)
+                def finisher():
+                    w.wait()
+                register(finisher)
+            """
+        ) == []
+
+    def test_fix_skips_positionally_filled_group(self, tmp_path):
+        # review finding: appending group= when the group slot is already
+        # filled positionally raises duplicate-argument TypeError
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f(t, group, dist, WORLD):\n"
+            "    dist.all_reduce(t, 0, WORLD)\n"
+            "    dist.broadcast(t, 0)\n"
+        )
+        from pytorch_distributed_example_tpu.tools.distlint import lint_file
+
+        fs = lint_file(str(bad), LintConfig(), root=str(tmp_path))
+        assert [f.rule for f in fs] == ["R004", "R004"]
+        n, _ = apply_fixes(fs, root=str(tmp_path))
+        assert n == 1  # only the broadcast (group slot open) is fixed
+        src = bad.read_text()
+        assert "dist.all_reduce(t, 0, WORLD)\n" in src
+        assert "dist.broadcast(t, 0, group=group)" in src
+
+    def test_update_baseline_without_baseline_is_exit_2(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = main(
+            [str(tmp_path / "ok.py"), "--root", str(tmp_path), "--no-config",
+             "--update-baseline"]
+        )
+        assert rc == 2
+        assert "--baseline" in capsys.readouterr().err

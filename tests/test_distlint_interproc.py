@@ -1,0 +1,206 @@
+"""Interprocedural distlint: the call-graph builder and effect engine.
+
+Covers the Project model edges the ISSUE names — cycles, decorated
+functions, methods resolved through `self` (incl. base classes),
+re-exported names (both the fixture package's `__init__` and the real
+`backends/__init__.py`) — and the acceptance fixture: a rank-gated
+caller reaching `all_reduce` only through two helper hops is flagged
+R001 with a full caller→callee trace. Pure AST analysis — no jax,
+quick tier."""
+
+import os
+
+from pytorch_distributed_example_tpu.tools.distlint import (
+    ClassInfo,
+    FunctionInfo,
+    LintConfig,
+    ModuleInfo,
+    build_project,
+    lint_paths,
+)
+
+from tests._mp_util import REPO
+
+FIXTURE = os.path.join("tests", "fixtures", "distlint_interproc")
+# the repo config EXCLUDES the fixture corpus (deliberate findings must
+# not fail the self-lint); these tests scan it explicitly with a plain
+# config instead
+_CFG = LintConfig(paths=[FIXTURE])
+
+# the corpus and the package are immutable within a test run: memoize the
+# (expensive) project builds and the fixture lint instead of recomputing
+# them per test
+_MEMO: dict = {}
+
+
+def _fixture_project():
+    if "fixture" not in _MEMO:
+        _MEMO["fixture"] = build_project([FIXTURE], root=REPO, config=_CFG)
+    return _MEMO["fixture"]
+
+
+def _fixture_findings():
+    if "findings" not in _MEMO:
+        _MEMO["findings"] = lint_paths(
+            [FIXTURE], root=REPO, config=_CFG, project=_fixture_project()
+        )
+    return _MEMO["findings"]
+
+
+def _package_project():
+    if "package" not in _MEMO:
+        _MEMO["package"] = build_project(
+            ["pytorch_distributed_example_tpu"], root=REPO
+        )
+    return _MEMO["package"]
+
+
+class TestEffectSummaries:
+    def test_two_hop_transitive_collective_effect(self):
+        proj = _fixture_project()
+        mod = proj.modules["tests.fixtures.distlint_interproc.outer"]
+        entry = mod.functions["entry"]
+        assert entry.coll_effect is not None
+        e = entry.coll_effect
+        assert e.prim_name == "all_reduce"
+        assert e.prim_path.endswith("distlint_interproc/inner.py")
+        # chain: entry -> sync_buffers -> flush
+        assert list(e.chain) == [
+            "outer.entry",
+            "middle.sync_buffers",
+            "inner.flush",
+        ]
+
+    def test_cycle_fixed_point_terminates_and_propagates(self):
+        proj = _fixture_project()
+        mod = proj.modules["tests.fixtures.distlint_interproc.cycles"]
+        assert mod.functions["ping"].coll_effect is not None
+        assert mod.functions["pong"].coll_effect is not None
+        assert mod.functions["pong"].coll_effect.prim_name == "barrier"
+
+    def test_decorated_function_still_resolves(self):
+        proj = _fixture_project()
+        mod = proj.modules["tests.fixtures.distlint_interproc.middle"]
+        assert mod.functions["sync_buffers"].coll_effect is not None
+
+    def test_self_and_base_class_method_resolution(self):
+        proj = _fixture_project()
+        mod = proj.modules["tests.fixtures.distlint_interproc.klass"]
+        flush = mod.functions["Reducer._flush_buckets"]
+        assert flush.coll_effect is not None
+        assert flush.coll_effect.prim_name == "all_reduce"
+        # the hop went through the BASE class method
+        assert "klass._ReducerBase._all_reduce_flat" in flush.coll_effect.chain
+
+
+class TestReExports:
+    def test_fixture_init_reexport(self):
+        proj = _fixture_project()
+        pkg = "tests.fixtures.distlint_interproc"
+        r = proj.resolve_symbol(pkg, "entry")
+        assert isinstance(r, FunctionInfo)
+        assert r.module == f"{pkg}.outer"
+
+    def test_real_backends_init_reexport(self):
+        """`from ...backends import XlaBackend` resolves through the real
+        backends/__init__.py re-export to the class in backends/xla.py."""
+        proj = _package_project()
+        r = proj.resolve_symbol(
+            "pytorch_distributed_example_tpu.backends", "XlaBackend"
+        )
+        assert isinstance(r, ClassInfo)
+        assert r.module == "pytorch_distributed_example_tpu.backends.xla"
+        # and module-alias chasing: backends.wrapper is a submodule
+        sub = proj.resolve_symbol(
+            "pytorch_distributed_example_tpu.backends", "wrapper"
+        )
+        assert isinstance(sub, ModuleInfo)
+
+
+class TestInterprocFindings:
+    def test_two_hop_rank_gate_flagged_with_trace(self):
+        """THE acceptance fixture: rank-gated caller two hops above the
+        collective is flagged R001, message carries the chain."""
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R001" and f.path.endswith("outer.py")
+        ]
+        assert len(fs) == 1
+        f = fs[0]
+        assert not f.suppressed
+        assert "sync_buffers" in f.message
+        assert "all_reduce" in f.message
+        assert "inner.py" in f.message
+        # the finding line IS the caller (outer.entry); the trace walks
+        # the remaining hops down to the primitive
+        assert list(f.trace) == ["middle.sync_buffers", "inner.flush"]
+
+    def test_cycle_participant_gated_call_flagged(self):
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R001" and f.path.endswith("cycles.py")
+        ]
+        assert any("pong" in f.message for f in fs)
+
+    def test_self_method_gate_flagged(self):
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R001" and f.path.endswith("klass.py")
+        ]
+        assert any("_flush_buckets" in f.message for f in fs)
+
+    def test_swallowed_effectful_call_flagged_r002(self):
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R002" and f.path.endswith("groups.py")
+        ]
+        assert len(fs) == 1
+        assert "sync_buffers" in fs[0].message and "all_reduce" in fs[0].message
+
+    def test_unforwarded_group_to_effectful_helper_flagged_r004(self):
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R004" and f.path.endswith("groups.py")
+        ]
+        assert len(fs) == 1
+        assert "helper" in fs[0].message and "`group`" in fs[0].message
+        # and it carries autofix metadata (--fix can forward it)
+        assert getattr(fs[0], "_fix", None) is not None
+
+    def test_store_blocking_helper_in_async_window_flagged_r003(self):
+        fs = [
+            f
+            for f in _fixture_findings()
+            if f.rule == "R003" and f.path.endswith("stores.py")
+        ]
+        assert len(fs) == 1
+        assert "read_flag" in fs[0].message
+
+
+class TestRealRepoGraph:
+    def test_ddp_sync_module_states_is_effectful(self):
+        """The motivating case from the ISSUE: `_sync_module_states`
+        (a helper, no collective name in sight at its call sites) must
+        summarize as may-issue-collective through its nested `flush`."""
+        proj = _package_project()
+        mod = proj.modules["pytorch_distributed_example_tpu.parallel.ddp"]
+        fi = mod.functions["_sync_module_states"]
+        assert fi.coll_effect is not None
+        assert fi.coll_effect.prim_name in ("broadcast", "all_reduce")
+
+    def test_reducer_reduce_is_effectful_via_dispatch(self):
+        proj = _package_project()
+        mod = proj.modules["pytorch_distributed_example_tpu.parallel.reducer"]
+        fi = mod.functions["Reducer.reduce"]
+        assert fi.coll_effect is not None
+
+    def test_store_get_summarizes_as_store_blocking(self):
+        proj = _package_project()
+        mod = proj.modules["pytorch_distributed_example_tpu.store"]
+        fi = mod.functions["TCPStore.get"]
+        assert fi.store_effect is not None

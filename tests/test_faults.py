@@ -1,6 +1,7 @@
 """Fault-injection subsystem: plan parsing, trigger counting, rank
 targeting, seeded determinism, env round-trip (spawn survival), and the
 generic action semantics call sites rely on."""
+# distlint: disable-file=R008 -- synthetic points ("p", "q", "child.op") exercise the plan MECHANISM itself, not wired injection points
 
 import json
 import os

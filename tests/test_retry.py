@@ -221,7 +221,7 @@ class TestStoreRetryUnderFaults:
             assert m.get("k") == b"v"
             assert m._stale == {}  # no plan: no cache growth
             faults.install_plan(
-                [{"point": "never.fires", "action": "reset"}],
+                [{"point": "never.fires", "action": "reset"}],  # distlint: disable=R008 -- a point matching nothing IS the fixture: armed-but-silent plan
                 export_env=False,
             )
             assert m.get("k") == b"v"
