@@ -112,6 +112,19 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # continuous-batching serve engine vs static-batch
+            # run-to-completion on the same model/hardware (ISSUE 5):
+            # goodput tokens/s + TTFT/TPOT percentiles
+            "serve",
+            [sys.executable, "benchmarks/serve_bench.py"]
+            + (
+                ["--preset", "small", "--requests", "24", "--slots", "8"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
             "llama_scaled_mfu",
             [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"]
             + (["--steps", "3", "--warmup", "1"] if q else []),

@@ -42,6 +42,11 @@ Named injection points wired in this package:
                                                     divergence — schedule.py)
     agent.heartbeat                                (node-elastic heartbeats)
     checkpoint.write / checkpoint.finalize         (integrity layer)
+    serve.admit / serve.step                       (serve engine: before each
+                                                    request prefill / each
+                                                    continuous-batching decode
+                                                    step — transient faults
+                                                    requeue in-flight work)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -121,6 +126,8 @@ KNOWN_POINTS = frozenset({
     "agent.heartbeat",
     "checkpoint.write",
     "checkpoint.finalize",
+    "serve.admit",
+    "serve.step",
     "train.step",
 })
 

@@ -22,7 +22,11 @@ from typing import Any, Optional
 import functools
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def sample_logits(logits, rng, temperature: float, top_k: Optional[int]):
+    """The shared sampling head: greedy (temperature=0), temperature
+    softmax, optional top-k truncation. `logits` is (..., vocab); one
+    rng samples the whole batch. The serve engine's slot batch vmaps
+    this over per-slot keys (`serve/decode.py`)."""
     import jax
     import jax.numpy as jnp
 
@@ -34,6 +38,9 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+_sample = sample_logits  # decode-loop-internal alias (pre-serve name)
 
 
 @functools.lru_cache(maxsize=32)

@@ -92,6 +92,19 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
+def apply_rope_batched(x, cos, sin):
+    """x: (B, L, H, D); cos/sin: (B, L, D/2) — per-SAMPLE position
+    angles, for decode batches where every row sits at its own absolute
+    position (the serve engine's slot batch)."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
 def _dense_attention(q, k, v, causal, scale):
     from ..ops.reference import dense_attention
 
@@ -102,7 +115,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, decode: bool = False):
+    def __call__(self, x, cos, sin, decode: bool = False, positions=None):
         cfg = self.cfg
         B, L, _ = x.shape
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -115,7 +128,7 @@ class Attention(nn.Module):
         scale = 1.0 / (Dh ** 0.5)
 
         if decode:
-            return self._decode(q, k, v, cos, sin, scale, dense)
+            return self._decode(q, k, v, cos, sin, scale, dense, positions)
 
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -132,14 +145,21 @@ class Attention(nn.Module):
         o = o.reshape(B, L, H * Dh)
         return dense(cfg.d_model, "o_proj")(o)
 
-    def _decode(self, q, k, v, cos, sin, scale, dense):
+    def _decode(self, q, k, v, cos, sin, scale, dense, positions=None):
         """KV-cache step: write this call's K/V at the running index into
         static (B, max_seq_len) buffers (flax "cache" collection), attend
         causally over the cache. One code path serves prefill (L = prompt
         length at index 0) and decode (L = 1) — static shapes throughout,
         so XLA compiles exactly two programs for the whole generate loop.
         cos/sin must cover max_seq_len; RoPE uses ABSOLUTE positions via a
-        dynamic slice at the cache index."""
+        dynamic slice at the cache index.
+
+        `positions` ((B,) int32, optional) switches to PER-SAMPLE cache
+        indices: row b's K/V land at positions[b] and row b attends keys
+        <= its own position — the serve engine's slot batch, where every
+        row is an independent request at its own depth. The scalar cache
+        index is neither read nor advanced on this path (per-slot lengths
+        live with the caller)."""
         from jax import lax
 
         cfg = self.cfg
@@ -165,29 +185,45 @@ class Attention(nn.Module):
         ci = self.variable(
             "cache", "index", lambda: jnp.zeros((), jnp.int32)
         )
-        idx = ci.value
-
-        pos_cos = lax.dynamic_slice_in_dim(cos, idx, L, axis=0)
-        pos_sin = lax.dynamic_slice_in_dim(sin, idx, L, axis=0)
-        q = apply_rope(q, pos_cos, pos_sin)
-        k = apply_rope(k, pos_cos, pos_sin)
-
-        kf = lax.dynamic_update_slice_in_dim(ck.value, k, idx, axis=1)
-        vf = lax.dynamic_update_slice_in_dim(cv.value, v, idx, axis=1)
-        if is_initialized:
-            ck.value = kf
-            cv.value = vf
-            ci.value = idx + L
+        key_pos = jnp.arange(M)
+        if positions is None:
+            idx = ci.value
+            pos_cos = lax.dynamic_slice_in_dim(cos, idx, L, axis=0)
+            pos_sin = lax.dynamic_slice_in_dim(sin, idx, L, axis=0)
+            q = apply_rope(q, pos_cos, pos_sin)
+            k = apply_rope(k, pos_cos, pos_sin)
+            kf = lax.dynamic_update_slice_in_dim(ck.value, k, idx, axis=1)
+            vf = lax.dynamic_update_slice_in_dim(cv.value, v, idx, axis=1)
+            if is_initialized:
+                ck.value = kf
+                cv.value = vf
+                ci.value = idx + L
+            q_pos = idx + jnp.arange(L)
+            mask = key_pos[None, :] <= q_pos[:, None]  # causal over cache
+            mask = mask[None]  # (1, L, M) broadcast over batch
+        else:
+            idx = positions.astype(jnp.int32)  # (B,)
+            pos = idx[:, None] + jnp.arange(L)[None, :]  # (B, L) absolute
+            q = apply_rope_batched(q, cos[pos], sin[pos])
+            k = apply_rope_batched(k, cos[pos], sin[pos])
+            write = jax.vmap(
+                lambda buf, upd, i: lax.dynamic_update_slice_in_dim(
+                    buf, upd, i, axis=0
+                )
+            )
+            kf = write(ck.value, k, idx)
+            vf = write(cv.value, v, idx)
+            if is_initialized:
+                ck.value = kf
+                cv.value = vf
+            mask = key_pos[None, None, :] <= pos[:, :, None]  # (B, L, M)
         # GQA: group the query heads and attend against the UN-repeated
         # cache — repeating the (B, M, KV, Dh) buffers up to H heads per
         # step would forfeit the KV-cache bandwidth saving GQA exists for
         rep = H // KV
         qg = q.reshape(B, L, KV, rep, Dh)
         s = jnp.einsum("blkrd,bmkd->bkrlm", qg, kf) * scale  # (B,KV,rep,L,M)
-        key_pos = jnp.arange(M)
-        q_pos = idx + jnp.arange(L)
-        mask = key_pos[None, :] <= q_pos[:, None]  # causal over the cache
-        s = jnp.where(mask[None, None, None], s.astype(jnp.float32), -1e30)
+        s = jnp.where(mask[:, None, None], s.astype(jnp.float32), -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(vf.dtype)
         o = jnp.einsum("bkrlm,bmkd->blkrd", p, vf).reshape(B, L, H * Dh)
         return dense(cfg.d_model, "o_proj")(o)
@@ -259,10 +295,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, decode: bool = False):
+    def __call__(self, x, cos, sin, decode: bool = False, positions=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin, decode
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin, decode,
+            positions,
         )
         mlp_cls = MoE if cfg.n_experts > 0 else MLP
         x = x + mlp_cls(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
@@ -273,13 +310,16 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False):
+    def __call__(self, tokens, decode: bool = False, positions=None):
         """tokens: (B, L) int32 → logits (B, L, vocab) fp32.
 
         `decode=True` switches attention to the KV-cache path (flax
         "cache" collection; apply with `mutable=["cache"]`): call once
         with the prompt (prefill), then with one token at a time —
-        `models/generate.py` wraps the loop."""
+        `models/generate.py` wraps the loop. `positions` ((B,) int32)
+        selects PER-SAMPLE cache indices instead of the shared scalar
+        index — the serve engine's slot-batch decode (`serve/`), where
+        each row advances from its own depth."""
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_embed"
@@ -295,7 +335,9 @@ class TransformerLM(nn.Module):
             if use_remat:
                 x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin)
             else:
-                x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin, decode)
+                x = block_cls(cfg, name=f"layers_{i}")(
+                    x, cos, sin, decode, positions
+                )
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

@@ -108,12 +108,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
     lse_ref[0] = (m + jnp.log(l_safe))[:, None]
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: (BH, L, D) → (o, lse)."""
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+         out_dtype=None):
+    """q,k,v: (BH, L, D) → (o, lse). `out_dtype` overrides the output
+    dtype (default q.dtype): the ring-attention combine requests f32 so
+    per-shard partials come straight from the kernel's f32 accumulator
+    instead of a bf16-rounded output (ADVICE r5 #2)."""
     BH, L, D = q.shape
     if _use_streaming(L, D, q.dtype.itemsize):
         return _fwd_streamed(q, k, v, scale, causal, block_q, block_k,
-                             interpret)
+                             interpret, out_dtype)
     grid = (BH, L // block_q)
 
     kernel = functools.partial(
@@ -137,7 +141,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
         ],
         interpret=interpret,
@@ -246,7 +250,8 @@ def _fwd_kernel_streamed(
         lse_ref[0] = (m_s[:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _fwd_streamed(q, k, v, scale, causal, block_q, block_k, interpret):
+def _fwd_streamed(q, k, v, scale, causal, block_q, block_k, interpret,
+                  out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
     BH, L, D = q.shape
@@ -268,7 +273,7 @@ def _fwd_streamed(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
         ],
         scratch_shapes=[

@@ -189,21 +189,28 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
 
 def _ring_flash_partial(qbh, k_cur, v_cur, src, r, causal, scale, bq, bk,
                         interpret):
-    """One ring step's flash partial: (o_b, lse_b), variant by origin."""
+    """One ring step's flash partial: (o_b, lse_b), variant by origin.
+
+    o_b is requested in f32 straight from the kernel's accumulator
+    (ADVICE r5 #2): rounding each shard's partial to bf16 before the
+    f32 logaddexp combine would re-introduce per-shard rounding the
+    streaming-softmax math otherwise avoids."""
     import jax.numpy as jnp
     from jax import lax
 
     from ..ops.flash_attention import _fwd
 
     def diag(_):
-        return _fwd(qbh, k_cur, v_cur, scale, True, bq, bk, interpret)
+        return _fwd(qbh, k_cur, v_cur, scale, True, bq, bk, interpret,
+                    out_dtype=jnp.float32)
 
     def full(_):
-        return _fwd(qbh, k_cur, v_cur, scale, False, bq, bk, interpret)
+        return _fwd(qbh, k_cur, v_cur, scale, False, bq, bk, interpret,
+                    out_dtype=jnp.float32)
 
     def skip(_):
         return (
-            jnp.zeros(qbh.shape, qbh.dtype),
+            jnp.zeros(qbh.shape, jnp.float32),
             jnp.full(qbh.shape[:2] + (1,), NEG_INF, jnp.float32),
         )
 
@@ -231,10 +238,9 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale, bq, bk,
             q, k_cur, v_cur, src, r, causal, scale, bq, bk, interpret
         )
         lse_new = jnp.logaddexp(lse, lse_b)
-        o = (
-            o * jnp.exp(lse - lse_new)
-            + o_b.astype(jnp.float32) * jnp.exp(lse_b - lse_new)
-        )
+        # o_b arrives f32 from the kernel accumulator (no bf16 rounding
+        # between per-shard compute and this combine)
+        o = o * jnp.exp(lse - lse_new) + o_b * jnp.exp(lse_b - lse_new)
         return (o, lse_new, lax.ppermute(k_cur, axis_name, perm),
                 lax.ppermute(v_cur, axis_name, perm))
 

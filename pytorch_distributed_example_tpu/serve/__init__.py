@@ -1,0 +1,17 @@
+"""Continuous-batching serve subsystem.
+
+The inference half of the north star: a slot-managed KV cache
+(`cache.py`), a single compiled batched decode step (`decode.py`), a
+request queue + scheduler with mid-stream retire-and-backfill
+(`engine.py`, `queue.py`), bucketed prefill shapes (`bucketing.py`),
+and a metrics block exposed over the debug HTTP frontend
+(`metrics.py`). `benchmarks/serve_bench.py` measures the goodput win
+over static-batch run-to-completion serving.
+"""
+
+from .bucketing import bucket_for, bucket_lengths  # noqa: F401
+from .cache import SlotKVCache  # noqa: F401
+from .decode import slot_programs  # noqa: F401
+from .engine import ServeEngine  # noqa: F401
+from .metrics import ServeMetrics, percentile  # noqa: F401
+from .queue import Completion, Request, RequestQueue  # noqa: F401

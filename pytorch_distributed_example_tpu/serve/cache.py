@@ -1,0 +1,127 @@
+"""Slot-based KV cache — the serve engine's memory manager.
+
+One statically-shaped cache tree per layer, ``(slots, max_seq_len,
+kv_heads, head_dim)`` K/V (the flax "cache" collection with the batch
+axis reinterpreted as SLOTS), plus per-slot position/length vectors kept
+host-side. Because every shape is fixed at construction, the jitted
+decode step (`serve/decode.py`) compiles exactly once and is reused for
+the engine's whole lifetime — requests come and go by slot index, never
+by reshape.
+
+Lifecycle: `allocate()` hands out a free slot, `write_prefill()` lands a
+prefilled request into it (overwriting the slot's FULL buffer, so a
+retired request's stale K/V can never leak into its successor),
+`free()` returns it, `reset()` clears everything. The cache tree itself
+is reused/replaced functionally — callers own exactly one live version.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from ..models.generate import init_cache
+
+__all__ = ["SlotKVCache", "land_slot"]
+
+
+def land_slot(tree, pre, slot):
+    """Pure slot landing: write a B=1 cache tree `pre` into slot `slot`
+    of the slot tree (full-buffer overwrite). Scalar flax `index` leaves
+    pass through untouched (per-slot lengths live with the caller, not
+    in the tree). The ONE copy of this logic — `write_prefill` jits it
+    standalone and `serve/decode.py`'s fused `write_slot` traces it
+    inside the donated state-lane write."""
+    import jax
+    from jax import lax
+
+    def leaf(buf, upd):
+        if buf.ndim == 0:
+            return buf
+        return lax.dynamic_update_slice_in_dim(buf, upd, slot, axis=0)
+
+    return jax.tree_util.tree_map(leaf, tree, pre)
+
+
+@functools.lru_cache(maxsize=8)
+def _write_slot_fn():
+    """Jitted standalone `land_slot` (compiles once per tree shapes)."""
+    import jax
+
+    return jax.jit(land_slot)
+
+
+class SlotKVCache:
+    """Slot-managed KV cache over `model`'s decode path.
+
+    `tree` is the live flax cache tree ((slots, M, KV, Dh) K/V per
+    layer); `lengths` is the host-side per-slot position vector (how
+    many cache positions are valid — also the position the NEXT token
+    will be written at). Free slots keep length 0.
+    """
+
+    def __init__(self, model, slots: int):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.model = model
+        self.slots = slots
+        self.tree = init_cache(model, slots)
+        self.lengths = np.zeros((slots,), np.int32)
+        self._in_use = np.zeros((slots,), bool)
+        self._free: List[int] = list(range(slots))
+
+    # -- slot lifecycle ----------------------------------------------------
+    def allocate(self) -> Optional[int]:
+        """A free slot index, or None when the cache is full."""
+        if not self._free:
+            return None
+        s = self._free.pop(0)
+        self._in_use[s] = True
+        return s
+
+    def free(self, slot: int) -> None:
+        if not self._in_use[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._in_use[slot] = False
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def reset(self) -> None:
+        """Free every slot. The device buffers are NOT cleared — a
+        prefill overwrites a slot's full buffer before reuse, so stale
+        K/V is unreachable by construction."""
+        self._in_use[:] = False
+        self.lengths[:] = 0
+        self._free = list(range(self.slots))
+
+    # -- data plane --------------------------------------------------------
+    def write_prefill(self, slot: int, prefill_tree, length: int) -> None:
+        """Land a B=1 prefill cache into `slot` (full-buffer overwrite)
+        and set its length. One compiled program for every slot/request."""
+        if not self._in_use[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        if not 0 < length <= self.model.cfg.max_seq_len:
+            raise ValueError(
+                f"prefill length {length} outside (0, "
+                f"{self.model.cfg.max_seq_len}]"
+            )
+        self.tree = _write_slot_fn()(self.tree, prefill_tree, slot)
+        self.lengths[slot] = length
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.slots) if self._in_use[s]]
+
+    @property
+    def occupancy(self) -> float:
+        return float(self._in_use.sum()) / self.slots
+
+    def __repr__(self) -> str:
+        return (
+            f"SlotKVCache(slots={self.slots}, "
+            f"active={int(self._in_use.sum())}, "
+            f"lengths={self.lengths.tolist()})"
+        )

@@ -1,0 +1,301 @@
+"""ServeEngine — continuous-batching scheduler over the slot KV cache.
+
+The serving loop the ROADMAP's "heavy traffic" north star needs:
+requests enter a queue (`serve/queue.py`), get admitted into cache
+slots as capacity frees up, and EVERY active slot advances one token
+per `step()` call through the single compiled decode program
+(`serve/decode.py`). When a request finishes (EOS or token budget) its
+slot is retired and immediately backfilled from the queue MID-STREAM —
+no run-to-completion barrier, which is exactly the multi-x goodput win
+`benchmarks/serve_bench.py` measures against the static-batch baseline.
+
+Fault surface: `serve.admit` fires before each prefill, `serve.step`
+before each decode batch (both in `faults.KNOWN_POINTS`). Transient
+faults (connection reset / dropped request) requeue the affected
+requests at the queue head and the engine carries on; because each
+request replays from its own seed, a greedy request's output is
+token-identical across any number of mid-stream requeues
+(`tests/test_serve.py` chaos cases).
+
+Synchronous single-owner design: one thread calls `submit()`/`step()`/
+`run()`; `ServeMetrics` is internally locked so the debug HTTP frontend
+can snapshot concurrently.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import faults
+from ..types import DistError
+from .bucketing import bucket_for, bucket_lengths
+from .cache import SlotKVCache
+from .decode import slot_programs
+from .metrics import ServeMetrics
+from .queue import Completion, Request, RequestQueue
+
+__all__ = ["ServeEngine"]
+
+# Faults the engine absorbs by requeueing work (the retry layer's
+# transient taxonomy): injected connection resets and dropped requests.
+# DistError "error" faults and real programming errors propagate.
+_TRANSIENT = (ConnectionResetError, faults.FaultTimeout)
+
+
+class ServeEngine:
+    def __init__(
+        self,
+        model,
+        params,
+        slots: int = 8,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        min_bucket: int = 16,
+        clock=time.monotonic,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        self.model = model
+        self.params = params["params"] if "params" in params else params
+        self.cfg = model.cfg
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.clock = clock
+        self.cache = SlotKVCache(model, slots)
+        self.queue = RequestQueue()
+        self.metrics = metrics or ServeMetrics(clock=clock, slots=slots)
+        self.metrics.slots = slots
+        self.buckets = bucket_lengths(self.cfg.max_seq_len, min_bucket)
+        self._prefill, self._write_slot, self._step = slot_programs(
+            model, temperature, top_k
+        )
+        S = slots
+        self._slot_req: List[Optional[Request]] = [None] * S
+        self._slot_tokens: List[List[int]] = [[] for _ in range(S)]
+        # device-resident per-slot state, donated through every step —
+        # the per-token hot path touches the host only for the (S,)
+        # next-token readback (see serve/decode.py)
+        import jax.numpy as jnp
+
+        self._dev_lengths = jnp.zeros((S,), jnp.int32)
+        self._dev_tokens = jnp.zeros((S,), jnp.int32)
+        self._dev_rngs = jnp.zeros((S, 2), jnp.uint32)
+        self.completions: Dict[str, Completion] = {}
+
+    # -- admission ---------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        rid: Optional[str] = None,
+        seed: int = 0,
+    ) -> str:
+        """Enqueue one generation request; returns its request id."""
+        req = Request(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            rid=rid or "",
+            seed=seed,
+        )
+        L = len(req.prompt)
+        if L < 1:
+            raise ValueError("empty prompt")
+        if L + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({L}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({self.cfg.max_seq_len})"
+            )
+        bucket_for(L, self.buckets)  # raises when no bucket fits
+        req.arrival_time = self.clock()
+        self.queue.put(req)
+        self.metrics.record_submit(req.arrival_time)
+        return req.rid
+
+    def _admit(self) -> None:
+        """Backfill free slots from the queue head (continuous batching:
+        called at the top of every step, so retirement and admission
+        interleave mid-stream)."""
+        import jax.numpy as jnp
+
+        while True:
+            if not self.queue:
+                return
+            slot = self.cache.allocate()
+            if slot is None:
+                return
+            req = self.queue.pop()
+            if req is None:  # racing submitter drained between checks
+                self.cache.free(slot)
+                return
+            try:
+                faults.fire("serve.admit", rid=req.rid)
+            except _TRANSIENT:
+                # transient admission fault: the request goes back to the
+                # HEAD (arrival order preserved) and this round stops —
+                # the next step() retries
+                self.cache.free(slot)
+                req.requeues += 1
+                self.queue.requeue_front(req)
+                self.metrics.record_requeue()
+                return
+            L = len(req.prompt)
+            Lb = bucket_for(L, self.buckets)
+            padded = np.zeros((1, Lb), np.int32)
+            padded[0, :L] = req.prompt
+            # prefill samples the first token on device off the request's
+            # seed (one readback for the scheduler); the fused write lands
+            # cache + state lanes in one donated program
+            pre_cache, _first_logits, first_dev, key = self._prefill(
+                self.params, jnp.asarray(padded), L, req.seed
+            )
+            first = int(first_dev)
+            (
+                self.cache.tree,
+                self._dev_lengths,
+                self._dev_tokens,
+                self._dev_rngs,
+            ) = self._write_slot(
+                self.cache.tree,
+                self._dev_lengths,
+                self._dev_tokens,
+                self._dev_rngs,
+                pre_cache,
+                slot,
+                L,
+                first_dev,
+                key,
+            )
+            self.cache.lengths[slot] = L  # host mirror for introspection
+            self._slot_req[slot] = req
+            self._slot_tokens[slot] = [first]
+            now = self.clock()
+            req.first_token_time = now
+            self.metrics.record_admit()
+            if (self.eos_id is not None and first == self.eos_id) or (
+                req.max_new_tokens == 1
+            ):
+                self._retire(
+                    slot,
+                    now,
+                    "eos"
+                    if self.eos_id is not None and first == self.eos_id
+                    else "length",
+                )
+
+    # -- decode ------------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: admit, advance every active slot one
+        token, retire finished requests. Returns True while work remains
+        (active slots or queued requests)."""
+        self._admit()
+        active = self.cache.active_slots
+        self.metrics.record_step(self.queue.depth, len(active))
+        if not active:
+            return bool(self.queue)
+        try:
+            faults.fire("serve.step", n_active=len(active))
+        except _TRANSIENT:
+            self.requeue_inflight()
+            return True
+        (
+            self.cache.tree,
+            self._dev_lengths,
+            nxt,
+            self._dev_rngs,
+        ) = self._step(
+            self.params,
+            self.cache.tree,
+            self._dev_lengths,
+            self._dev_tokens,
+            self._dev_rngs,
+        )
+        self._dev_tokens = nxt
+        nxt_h = np.asarray(nxt)  # the hot path's one host readback
+        now = self.clock()
+        for s in active:
+            req = self._slot_req[s]
+            tok = int(nxt_h[s])
+            self._slot_tokens[s].append(tok)
+            self.cache.lengths[s] += 1
+            if self.eos_id is not None and tok == self.eos_id:
+                self._retire(s, now, "eos")
+            elif len(self._slot_tokens[s]) >= req.max_new_tokens:
+                self._retire(s, now, "length")
+        return bool(self.cache.active_slots) or bool(self.queue)
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, Completion]:
+        """Drive step() until the queue and slots drain (or max_steps);
+        returns the completion map."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                raise DistError(
+                    f"serve engine did not drain within {max_steps} steps "
+                    f"(active={len(self.cache.active_slots)}, "
+                    f"queued={self.queue.depth})"
+                )
+        return self.completions
+
+    # -- retirement / fault recovery ---------------------------------------
+    def _retire(self, slot: int, now: float, reason: str) -> None:
+        req = self._slot_req[slot]
+        toks = self._slot_tokens[slot]
+        n = len(toks)
+        tpot = (
+            (now - req.first_token_time) / (n - 1) if n > 1 else 0.0
+        )
+        comp = Completion(
+            rid=req.rid,
+            tokens=list(toks),
+            prompt_len=len(req.prompt),
+            finish_reason=reason,
+            ttft_s=req.first_token_time - req.arrival_time,
+            tpot_s=tpot,
+            e2e_s=now - req.arrival_time,
+            requeues=req.requeues,
+        )
+        self.completions[req.rid] = comp
+        self.metrics.record_complete(now, n, comp.ttft_s, tpot, comp.e2e_s)
+        self._slot_req[slot] = None
+        self._slot_tokens[slot] = []
+        self.cache.free(slot)
+
+    def requeue_inflight(self) -> int:
+        """Drain every in-flight request back to the queue HEAD in
+        ARRIVAL order (slot index says nothing about age once backfill
+        has recycled slots) and free the slots — the mid-stream
+        kill/restart path. Each request replays from scratch off its own
+        seed, so greedy outputs are unchanged by any number of
+        requeues."""
+        inflight = sorted(
+            (
+                s
+                for s in range(self.cache.slots)
+                if self._slot_req[s] is not None
+            ),
+            key=lambda s: self._slot_req[s].arrival_time,
+        )
+        for s in reversed(inflight):
+            req = self._slot_req[s]
+            req.requeues += 1
+            req.first_token_time = None
+            self._slot_req[s] = None
+            self._slot_tokens[s] = []
+            self.queue.requeue_front(req)
+            self.cache.free(s)
+        self.metrics.record_requeue(len(inflight))
+        return len(inflight)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return len(self.cache.active_slots)
+
+    @property
+    def pending(self) -> int:
+        return self.queue.depth + self.num_active

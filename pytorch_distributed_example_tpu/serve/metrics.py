@@ -1,0 +1,155 @@
+"""ServeMetrics — the engine's observability block.
+
+Tracks queue depth, slot occupancy, TTFT / TPOT / end-to-end latency
+percentiles, and tokens/s goodput (completed-request tokens only — a
+request killed mid-stream contributes nothing until its replay
+finishes, which is what makes the number "goodput" rather than raw
+throughput). A `clock` injection point keeps the accounting testable
+with a fake clock; `snapshot()` returns plain JSON for the debug HTTP
+frontend (`utils/debug_http.py` route ``/serve``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, Optional
+
+__all__ = ["ServeMetrics", "percentile"]
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """Linear-interpolated percentile (q in [0, 100]) — numpy-free so a
+    snapshot never allocates device memory."""
+    xs = sorted(values)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return float(xs[0])
+    pos = (len(xs) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return float(xs[lo] * (1 - frac) + xs[hi] * frac)
+
+
+class ServeMetrics:
+    def __init__(
+        self,
+        clock=time.monotonic,
+        slots: int = 0,
+        max_latency_samples: int = 2048,
+    ):
+        self.clock = clock
+        self.slots = slots
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.admitted = 0  # admission ATTEMPTS (a requeued request re-admits)
+        self.completed = 0
+        self.requeued = 0
+        self.steps = 0
+        self.tokens_completed = 0
+        self.queue_depth = 0
+        self.slots_active = 0
+        self._occupancy_steps = 0.0  # sum of per-step occupancy fractions
+        # bounded windows: a long-lived serving process must not grow
+        # (or re-sort under the lock) an unbounded history per /serve poll
+        self.ttft_s: deque = deque(maxlen=max_latency_samples)
+        self.tpot_s: deque = deque(maxlen=max_latency_samples)
+        self.e2e_s: deque = deque(maxlen=max_latency_samples)
+        self._first_submit: Optional[float] = None
+        self._last_complete: Optional[float] = None
+
+    # -- recording hooks (engine-driven) -----------------------------------
+    def record_submit(self, t: float) -> None:
+        with self._lock:
+            self.submitted += 1
+            if self._first_submit is None:
+                self._first_submit = t
+
+    def record_admit(self) -> None:
+        with self._lock:
+            self.admitted += 1
+
+    def record_step(self, queue_depth: int, slots_active: int) -> None:
+        with self._lock:
+            self.steps += 1
+            self.queue_depth = queue_depth
+            self.slots_active = slots_active
+            if self.slots:
+                self._occupancy_steps += slots_active / self.slots
+
+    def record_requeue(self, n: int = 1) -> None:
+        with self._lock:
+            self.requeued += n
+
+    def record_complete(
+        self,
+        t: float,
+        n_tokens: int,
+        ttft_s: float,
+        tpot_s: float,
+        e2e_s: float,
+    ) -> None:
+        """All latency samples land here, at COMPLETION — an admission
+        attempt aborted by a mid-stream requeue leaves no sample, so the
+        percentiles describe only requests that actually finished."""
+        with self._lock:
+            self.completed += 1
+            self.tokens_completed += n_tokens
+            self.ttft_s.append(ttft_s)
+            self.tpot_s.append(tpot_s)
+            self.e2e_s.append(e2e_s)
+            self._last_complete = t
+
+    # -- reporting ---------------------------------------------------------
+    def goodput_tokens_per_sec(self) -> float:
+        """Completed-request tokens over the first-submit → last-complete
+        window. 0 until at least one request completed."""
+        with self._lock:
+            if (
+                self._first_submit is None
+                or self._last_complete is None
+                or self._last_complete <= self._first_submit
+            ):
+                return 0.0
+            return self.tokens_completed / (
+                self._last_complete - self._first_submit
+            )
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            lat = {
+                name: {
+                    "p50_ms": round(percentile(xs, 50) * 1e3, 3),
+                    "p90_ms": round(percentile(xs, 90) * 1e3, 3),
+                    "p99_ms": round(percentile(xs, 99) * 1e3, 3),
+                    "n": len(xs),
+                }
+                for name, xs in (
+                    ("ttft", self.ttft_s),
+                    ("tpot", self.tpot_s),
+                    ("e2e", self.e2e_s),
+                )
+            }
+            occupancy = (
+                self._occupancy_steps / self.steps if self.steps else 0.0
+            )
+            snap = {
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "requeued": self.requeued,
+                "steps": self.steps,
+                "queue_depth": self.queue_depth,
+                "slots": self.slots,
+                "slots_active": self.slots_active,
+                "mean_occupancy": round(occupancy, 4),
+                "tokens_completed": self.tokens_completed,
+                "latency": lat,
+            }
+        snap["goodput_tokens_per_sec"] = round(
+            self.goodput_tokens_per_sec(), 3
+        )
+        return snap

@@ -1,0 +1,86 @@
+"""Request queue — admission buffer between callers and the engine.
+
+Thread-safe FIFO of `Request`s. The engine pops from the head when a
+slot frees up (continuous batching backfill); transiently-failed
+admissions and requeued in-flight work go back to the FRONT so a fault
+never reorders a request behind traffic that arrived after it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "Completion", "RequestQueue"]
+
+_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. `seed` pins the sampling stream so a
+    requeued (fault-interrupted) request replays deterministically."""
+
+    prompt: np.ndarray  # (L,) int32
+    max_new_tokens: int
+    rid: str = ""
+    seed: int = 0
+    arrival_time: float = 0.0  # stamped by the engine's clock at submit
+    first_token_time: Optional[float] = None
+    requeues: int = 0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if not self.rid:
+            self.rid = f"req-{next(_ids)}"
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+
+
+@dataclass
+class Completion:
+    rid: str
+    tokens: List[int]
+    prompt_len: int
+    finish_reason: str  # "eos" | "length"
+    ttft_s: float
+    tpot_s: float  # mean seconds/token after the first
+    e2e_s: float
+    requeues: int = 0
+
+
+class RequestQueue:
+    def __init__(self):
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+
+    def put(self, req: Request) -> None:
+        with self._lock:
+            self._q.append(req)
+
+    def requeue_front(self, req: Request) -> None:
+        """Return a request to the head (fault recovery path)."""
+        with self._lock:
+            self._q.appendleft(req)
+
+    def pop(self) -> Optional[Request]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def __bool__(self) -> bool:
+        return self.depth > 0
+
+    def __len__(self) -> int:
+        return self.depth

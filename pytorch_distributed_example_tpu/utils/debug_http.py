@@ -13,11 +13,15 @@ Routes (all JSON):
   /status      per-group ProcessGroupStatus (last enqueued/completed op)
   /flight_recorder   ring-buffer dump (the dump-on-timeout payload, live)
   /ddp_logging tables from registered DDPLogger instances
+  /serve       ServeMetrics snapshots from registered serve engines
+               (queue depth, slot occupancy, TTFT/TPOT/e2e percentiles,
+               goodput tokens/s)
 
 Usage:
     from pytorch_distributed_example_tpu.utils.debug_http import DebugServer
     srv = DebugServer()          # port=0 -> ephemeral; .port tells you
     srv.register_ddp_logger("model", ddp.logger)
+    srv.register_serve_metrics("engine", engine.metrics)
     ...
     srv.shutdown()
 """
@@ -38,6 +42,7 @@ class _UnknownRoute(Exception):
 class DebugServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._loggers: Dict[str, object] = {}
+        self._serve_metrics: Dict[str, object] = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -79,7 +84,13 @@ class DebugServer:
         path = path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/":
             return {
-                "routes": ["/world", "/status", "/flight_recorder", "/ddp_logging"]
+                "routes": [
+                    "/world",
+                    "/status",
+                    "/flight_recorder",
+                    "/ddp_logging",
+                    "/serve",
+                ]
             }
         if path == "/world":
             return self._world()
@@ -93,6 +104,11 @@ class DebugServer:
             return {
                 name: lg.get_ddp_logging_data()
                 for name, lg in self._loggers.items()
+            }
+        if path == "/serve":
+            return {
+                name: m.snapshot()
+                for name, m in self._serve_metrics.items()
             }
         raise _UnknownRoute(path)
 
@@ -130,6 +146,10 @@ class DebugServer:
     # -- registration / lifecycle ------------------------------------------
     def register_ddp_logger(self, name: str, logger) -> None:
         self._loggers[name] = logger
+
+    def register_serve_metrics(self, name: str, metrics) -> None:
+        """Expose a ServeMetrics block (serve/metrics.py) at /serve."""
+        self._serve_metrics[name] = metrics
 
     @property
     def url(self) -> str:

@@ -87,6 +87,44 @@ class TestFlashBackward:
             )
 
 
+class TestFwdOutDtype:
+    def test_f32_partials_for_ring_combine(self):
+        """ADVICE r5 #2: `_fwd(..., out_dtype=f32)` hands the ring
+        combine the kernel's f32 accumulator directly. Contract: the
+        default output is still q.dtype, and the f32 output rounds to
+        EXACTLY the default bf16 output (same accumulator, one cast)."""
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.ops.flash_attention import (
+            _fwd,
+            _interpret_default,
+        )
+
+        gen = np.random.default_rng(5)
+        BH, L, D = 4, 256, 32
+        mk = lambda: jnp.asarray(
+            gen.standard_normal((BH, L, D)), jnp.bfloat16
+        )
+        q, k, v = mk(), mk(), mk()
+        interp = _interpret_default()
+        o16, lse16 = _fwd(q, k, v, 1.0 / D ** 0.5, True, 64, 64, interp)
+        o32, lse32 = _fwd(
+            q, k, v, 1.0 / D ** 0.5, True, 64, 64, interp,
+            out_dtype=jnp.float32,
+        )
+        assert o16.dtype == jnp.bfloat16 and o32.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(o32.astype(jnp.bfloat16), dtype=np.float32),
+            np.asarray(o16, dtype=np.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(lse32), np.asarray(lse16))
+        # and the f32 output genuinely carries sub-bf16 precision
+        assert not np.array_equal(
+            np.asarray(o32),
+            np.asarray(o32.astype(jnp.bfloat16).astype(jnp.float32)),
+        )
+
+
 class TestFlashStreamed:
     """The long-context streamed variant: k/v blocks ride the grid with
     scratch accumulators instead of sitting whole in VMEM (unlocks

