@@ -125,6 +125,45 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # same bimodal traffic, production context-window
+            # provisioning (ISSUE 6): dense pays max_seq per slot, the
+            # paged pool pays live tokens — the >= 4x cache-memory row
+            "serve_paged_mem",
+            [sys.executable, "benchmarks/serve_bench.py", "--max-seq", "512"]
+            + (
+                ["--preset", "small", "--requests", "24", "--slots", "8"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            # long-prompt burst + trickling shorts, chunked vs unchunked
+            # prefill (ISSUE 6): short-class p99 TTFT bounding
+            "serve_longburst",
+            [sys.executable, "benchmarks/serve_bench.py", "--trace",
+             "longburst"]
+            + (
+                ["--preset", "small", "--requests", "24", "--slots", "8"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            # tensor-parallel decode goodput scaling 1 -> 2 chips
+            # (ISSUE 6, >= 1.7x target on TPU; CPU runs are a virtual-
+            # device wiring smoke, not a measurement)
+            "serve_tp",
+            [sys.executable, "benchmarks/serve_bench.py", "--tp", "2"]
+            + (
+                ["--preset", "tiny", "--requests", "12", "--slots", "4"]
+                if q
+                else ["--bf16"]
+            ),
+            {},
+        ),
+        (
             "llama_scaled_mfu",
             [sys.executable, "benchmarks/llama_scaled.py", "--mode", "mfu"]
             + (["--steps", "3", "--warmup", "1"] if q else []),

@@ -1,10 +1,11 @@
-"""Continuous-batching serve benchmark — goodput vs static batching.
+"""Continuous-batching serve benchmark — goodput vs static batching,
+paged-cache memory per request, chunked-prefill TTFT, TP scaling.
 
-Replays a mixed-length Poisson request stream against TWO serving
-regimes on the same model/hardware:
+The default `--trace bimodal` replays a mixed-length Poisson request
+stream against TWO serving regimes on the same model/hardware:
 
-* **engine** — `serve.ServeEngine`: slot KV cache, bucketed prefill,
-  mid-stream retire-and-backfill (continuous batching).
+* **engine** — `serve.ServeEngine`: paged block-pool KV cache, bucketed
+  prefill, mid-stream retire-and-backfill (continuous batching).
 * **static** — the pre-serve regime this repo's `generate()` path
   implies: a fixed batch of `--slots` requests, prompts padded to the
   longest bucket, decoded RUN-TO-COMPLETION for the longest request's
@@ -20,10 +21,25 @@ isolates pure scheduling efficiency from queueing luck).
 Figure of merit: **goodput** = REQUESTED tokens completed per second of
 wall time (padding tokens the static regime generates past a request's
 budget are waste, not goodput), plus TTFT/TPOT/e2e percentiles — the
-run-to-completion regime's p99 TTFT is its entire batch latency.
+run-to-completion regime's p99 TTFT is its entire batch latency — plus
+the paged pool's cache-memory-per-request columns (mean live bytes per
+in-flight request vs the dense per-slot layout's constant).
+
+`--trace longburst` is the chunked-prefill row: a burst of LONG prompts
+at t=0 with short requests trickling in behind it, replayed once
+unchunked and once with `--prefill-chunk` tokens per step. Figure of
+merit: the short class's p99 TTFT — chunking bounds it (a short arrival
+waits behind at most one chunk, not a whole long prefill).
+
+`--tp N` (N > 1) is the multi-chip row: the same bimodal engine replay
+at tp=1 and tp=N over a ("tp", N) device mesh (params Megatron-sharded,
+the block pool sharded on the KV-head axis, slot lanes replicated) —
+goodput scaling 1→N chips. On a CPU host it self-provisions virtual
+devices (wiring smoke); the measurement row is the TPU run.
 
 Usage: python benchmarks/serve_bench.py [--preset small|base]
     [--slots 8] [--requests 48] [--rate 0] [--seed 0] [--bf16]
+    [--trace bimodal|longburst] [--prefill-chunk 32] [--tp N]
 
 Measured (CPU fallback, defaults): engine 318.8 tok/s vs static 102.5 —
 3.1x goodput, p99 TTFT 4.1 s vs 18.9 s. Caveat: `--bf16` on the CPU
@@ -77,18 +93,43 @@ def make_traffic(n: int, rate: float, seed: int):
     ]
 
 
-def run_engine(model, params, traffic, prompts, slots):
-    """Timed continuous-batching replay; returns (metrics, makespan_s)."""
+def make_longburst_traffic(n_long: int, n_short: int, seed: int):
+    """[(arrival_s, prompt_len, max_new, klass)]: `n_long` long-prompt
+    requests burst at t=0, `n_short` short requests trickle in behind
+    them — the trace whose short-class p99 TTFT chunked prefill exists
+    to bound."""
+    import numpy as np
+
+    gen = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_long):
+        out.append((0.0, int(gen.integers(96, 129)),
+                    int(gen.integers(8, 17)), "long"))
+    for i in range(n_short):
+        out.append((0.05 * (i + 1), int(gen.integers(8, 17)),
+                    int(gen.integers(8, 17)), "short"))
+    return out
+
+
+def run_engine(model, params, traffic, prompts, slots, **engine_kw):
+    """Timed continuous-batching replay; returns (engine, makespan_s).
+    Requests carry their TRUE trace arrival (the driver can only submit
+    between steps; the static baseline measures from trace arrival too).
+    """
     from pytorch_distributed_example_tpu.serve import ServeEngine
 
-    engine = ServeEngine(model, params, slots=slots, min_bucket=8)
+    # arrival stamps below are perf_counter-based: the engine clock must
+    # share that timebase or TTFT mixes clocks
+    engine = ServeEngine(model, params, slots=slots, min_bucket=8,
+                         clock=time.perf_counter, **engine_kw)
     t0 = time.perf_counter()
     i = 0
     n = len(traffic)
     while i < n or engine.pending:
         now = time.perf_counter() - t0
         while i < n and traffic[i][0] <= now:
-            engine.submit(prompts[i], traffic[i][2], rid=f"r{i}")
+            engine.submit(prompts[i], traffic[i][2], rid=f"r{i}",
+                          arrival_time=t0 + traffic[i][0])
             i += 1
         if not engine.step() and i < n:
             time.sleep(
@@ -150,7 +191,45 @@ def main():
     )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument(
+        "--trace", choices=["bimodal", "longburst"], default="bimodal",
+        help="bimodal: goodput vs static (PR 4 row); longburst: "
+        "chunked-vs-unchunked short-class p99 TTFT",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=32,
+        help="prefill_chunk_tokens for the longburst chunked run",
+    )
+    ap.add_argument(
+        "--tp", type=int, default=1,
+        help="> 1: add the multi-chip row — bimodal engine replay at "
+        "tp=1 vs tp=N over a ('tp', N) mesh (goodput scaling)",
+    )
+    ap.add_argument(
+        "--max-seq", type=int, default=0,
+        help="context window BOTH regimes provision per request "
+        "(0 = trace-exact, the PR 4-comparable default). Production "
+        "provisions the advertised window, not the trace max — the "
+        "dense layout pays max_seq per slot while the paged pool pays "
+        "live tokens, so e.g. 512 is the cache-memory row where the "
+        ">= 4x reduction shows on the SAME bimodal traffic",
+    )
     args = ap.parse_args()
+
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if args.tp > 1 and not ({"tpu", "gpu", "cuda", "rocm"} & set(
+        platforms.replace(",", " ").split()
+    )):
+        # CPU wiring smoke: provision virtual devices BEFORE jax loads.
+        # The flag only affects the host (CPU) platform, so it is inert
+        # if jax ends up picking an accelerator anyway.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.tp}"
+            )
 
     import jax
     import jax.numpy as jnp
@@ -165,7 +244,13 @@ def main():
     from pytorch_distributed_example_tpu.serve import ServeEngine
     from pytorch_distributed_example_tpu.serve.metrics import percentile
 
-    max_seq = MAX_PROMPT + LONG_NEW[1]  # static budget both regimes share
+    trace_max = MAX_PROMPT + LONG_NEW[1]  # worst-case request footprint
+    max_seq = args.max_seq or trace_max
+    if max_seq < trace_max:
+        raise SystemExit(
+            f"--max-seq {max_seq} cannot hold the trace's worst request "
+            f"({trace_max} tokens)"
+        )
     cfg = TransformerConfig(
         max_seq_len=max_seq,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
@@ -179,12 +264,119 @@ def main():
         jnp.asarray(gen.integers(0, cfg.vocab_size, (1, 8)), jnp.int32),
     )
 
+    if args.trace == "longburst":
+        n_long = max(2, args.requests // 8)
+        n_short = args.requests - n_long
+        lb = make_longburst_traffic(n_long, n_short, args.seed)
+        lb_prompts = [
+            gen.integers(0, cfg.vocab_size, (t[1],)).astype(np.int32)
+            for t in lb
+        ]
+
+        def replay(chunk):
+            warm = ServeEngine(
+                model, params, slots=args.slots, min_bucket=8,
+                prefill_chunk_tokens=chunk,
+            )
+            for p in lb_prompts:
+                warm.submit(p, 2)
+            warm.run(max_steps=200 * len(lb))
+            eng, makespan = run_engine(
+                model, params, lb, lb_prompts, args.slots,
+                prefill_chunk_tokens=chunk,
+            )
+            assert eng.metrics.completed == len(lb)
+            ttft = [
+                eng.completions[f"r{i}"].ttft_s
+                for i, t in enumerate(lb)
+                if t[3] == "short"
+            ]
+            return sum(t[2] for t in lb) / makespan, ttft
+
+        goodput_u, ttft_u = replay(None)
+        goodput_c, ttft_c = replay(args.prefill_chunk)
+        p99_u = percentile(ttft_u, 99)
+        p99_c = percentile(ttft_c, 99)
+        rec = emit(
+            "serve_longburst_short_ttft_p99_ms",
+            p99_c * 1e3,
+            "ms",
+            unchunked_short_ttft_p99_ms=round(p99_u * 1e3, 3),
+            chunked_over_unchunked=round(p99_c / max(p99_u, 1e-9), 3),
+            ttft_bounded=bool(p99_c < p99_u),
+            prefill_chunk_tokens=args.prefill_chunk,
+            n_long=n_long,
+            n_short=n_short,
+            short_ttft_p50_ms=round(percentile(ttft_c, 50) * 1e3, 3),
+            unchunked_short_ttft_p50_ms=round(
+                percentile(ttft_u, 50) * 1e3, 3
+            ),
+            goodput_chunked_tokens_per_sec=round(goodput_c, 3),
+            goodput_unchunked_tokens_per_sec=round(goodput_u, 3),
+            preset=args.preset,
+            slots=args.slots,
+            dtype=str(jnp.dtype(cfg.dtype).name),
+            platform=jax.devices()[0].platform,
+            device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+            timing="readback_barrier",
+        )
+        if on_tpu():
+            persist_result("serve_longburst", rec)
+        return
+
     traffic = make_traffic(args.requests, args.rate, args.seed)
     prompts = [
         gen.integers(0, cfg.vocab_size, (t[1],)).astype(np.int32)
         for t in traffic
     ]
     useful_tokens = sum(t[2] for t in traffic)
+
+    if args.tp > 1:
+        from pytorch_distributed_example_tpu.mesh import init_device_mesh
+
+        if len(jax.devices()) < args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} needs {args.tp} devices, "
+                f"have {len(jax.devices())}"
+            )
+        mesh = init_device_mesh(
+            ("tp",), (args.tp,), devices=jax.devices()[: args.tp]
+        )
+
+        def replay_tp(mesh_):
+            warm = ServeEngine(
+                model, params, slots=args.slots, min_bucket=8, mesh=mesh_
+            )
+            for p in prompts:
+                warm.submit(p, 2)
+            warm.run(max_steps=200 * len(traffic))
+            eng, makespan = run_engine(
+                model, params, traffic, prompts, args.slots, mesh=mesh_
+            )
+            assert eng.metrics.completed == args.requests
+            return useful_tokens / makespan
+
+        goodput_1 = replay_tp(None)
+        goodput_n = replay_tp(mesh)
+        rec = emit(
+            "serve_tp_goodput_scaling",
+            goodput_n / max(goodput_1, 1e-9),
+            "x",
+            tp=args.tp,
+            goodput_1chip_tokens_per_sec=round(goodput_1, 3),
+            goodput_nchip_tokens_per_sec=round(goodput_n, 3),
+            target_scaling_2chip=1.7,
+            preset=args.preset,
+            slots=args.slots,
+            requests=args.requests,
+            dtype=str(jnp.dtype(cfg.dtype).name),
+            platform=jax.devices()[0].platform,
+            device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+            timing="readback_barrier",
+        )
+        if on_tpu():
+            persist_result("serve_tp", rec)
+        return
 
     # -- warm both regimes' compiles OUTSIDE the timed windows ------------
     warm = ServeEngine(model, params, slots=args.slots, min_bucket=8)
@@ -234,13 +426,27 @@ def main():
         static_ttft_p99_ms=round(percentile(s_ttft, 99) * 1e3, 3),
         static_e2e_p99_ms=round(percentile(s_e2e, 99) * 1e3, 3),
         mean_occupancy=snap["mean_occupancy"],
+        # paged-cache memory per request vs the dense per-slot layout
+        # (the ISSUE 6 >= 4x claim, observable in the goodput run)
+        cache_bytes_per_live_request_mean=snap["cache_pool"][
+            "bytes_per_live_request_mean"
+        ],
+        dense_cache_bytes_per_request=snap["cache_pool"][
+            "dense_bytes_per_request"
+        ],
+        cache_dense_reduction_x=snap["cache_pool"]["dense_reduction_x"],
+        cache_pool_mean_utilization=snap["cache_pool"]["mean_utilization"],
+        max_seq=max_seq,
+        provisioning="trace-exact" if max_seq == trace_max else "window",
         dtype=str(jnp.dtype(cfg.dtype).name),
         platform=jax.devices()[0].platform,
         device_kind=getattr(jax.devices()[0], "device_kind", "?"),
         timing="readback_barrier",
     )
     if on_tpu():
-        persist_result("serve", rec)
+        persist_result(
+            "serve" if max_seq == trace_max else "serve_paged_mem", rec
+        )
 
 
 if __name__ == "__main__":

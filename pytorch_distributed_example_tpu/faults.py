@@ -43,10 +43,15 @@ Named injection points wired in this package:
     agent.heartbeat                                (node-elastic heartbeats)
     checkpoint.write / checkpoint.finalize         (integrity layer)
     serve.admit / serve.step                       (serve engine: before each
-                                                    request prefill / each
+                                                    request admission / each
                                                     continuous-batching decode
                                                     step — transient faults
                                                     requeue in-flight work)
+    serve.prefill_chunk                            (before each paged prefill
+                                                    chunk — a transient fault
+                                                    requeues the half-prefilled
+                                                    request, frees its blocks,
+                                                    and it replays from seed)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -127,6 +132,7 @@ KNOWN_POINTS = frozenset({
     "checkpoint.write",
     "checkpoint.finalize",
     "serve.admit",
+    "serve.prefill_chunk",
     "serve.step",
     "train.step",
 })

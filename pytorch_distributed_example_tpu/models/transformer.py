@@ -115,7 +115,10 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, decode: bool = False, positions=None):
+    def __call__(
+        self, x, cos, sin, decode: bool = False, positions=None,
+        block_tables=None,
+    ):
         cfg = self.cfg
         B, L, _ = x.shape
         H, KV, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -128,7 +131,9 @@ class Attention(nn.Module):
         scale = 1.0 / (Dh ** 0.5)
 
         if decode:
-            return self._decode(q, k, v, cos, sin, scale, dense, positions)
+            return self._decode(
+                q, k, v, cos, sin, scale, dense, positions, block_tables
+            )
 
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -145,7 +150,10 @@ class Attention(nn.Module):
         o = o.reshape(B, L, H * Dh)
         return dense(cfg.d_model, "o_proj")(o)
 
-    def _decode(self, q, k, v, cos, sin, scale, dense, positions=None):
+    def _decode(
+        self, q, k, v, cos, sin, scale, dense, positions=None,
+        block_tables=None,
+    ):
         """KV-cache step: write this call's K/V at the running index into
         static (B, max_seq_len) buffers (flax "cache" collection), attend
         causally over the cache. One code path serves prefill (L = prompt
@@ -159,7 +167,21 @@ class Attention(nn.Module):
         <= its own position — the serve engine's slot batch, where every
         row is an independent request at its own depth. The scalar cache
         index is neither read nor advanced on this path (per-slot lengths
-        live with the caller)."""
+        live with the caller).
+
+        `block_tables` ((B, nb) int32, requires `positions`) switches the
+        cache variables from per-row dense buffers to a PAGED block pool
+        shared by every row: k/v are (num_blocks, block_size, KV, Dh) and
+        row b's logical block j lives at physical block
+        `block_tables[b, j]`. Writes scatter each token to
+        (block, offset) through a flat view — positions whose logical
+        block is unallocated (table entry == num_blocks) or out of range
+        fall out of bounds and are DROPPED, which is what lets a parked
+        (retired) slot lane and a padded prefill chunk ride through the
+        step without touching any live request's blocks. Reads gather the
+        row's logical layout (`ops.gather_paged_kv`) and attend under the
+        same absolute-position causal mask; there is no "index" variable
+        on this path (the pool has no per-row cursor)."""
         from jax import lax
 
         cfg = self.cfg
@@ -176,6 +198,18 @@ class Attention(nn.Module):
         # present) only CREATE them — persisting the write would hand the
         # caller a cache whose index already advanced past the init input
         is_initialized = self.has_variable("cache", "k")
+        if block_tables is not None:
+            if positions is None:
+                raise ValueError("block_tables requires positions")
+            if not is_initialized:
+                raise ValueError(
+                    "paged decode needs a pre-built block-pool cache tree "
+                    "(serve.cache.init_paged_cache) passed via apply(); "
+                    "the module cannot size the pool from the batch"
+                )
+            return self._decode_paged(
+                q, k, v, cos, sin, scale, dense, positions, block_tables
+            )
         ck = self.variable(
             "cache", "k", jnp.zeros, (B, M, KV, Dh), k.dtype
         )
@@ -223,6 +257,71 @@ class Attention(nn.Module):
         rep = H // KV
         qg = q.reshape(B, L, KV, rep, Dh)
         s = jnp.einsum("blkrd,bmkd->bkrlm", qg, kf) * scale  # (B,KV,rep,L,M)
+        s = jnp.where(mask[:, None, None], s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vf.dtype)
+        o = jnp.einsum("bkrlm,bmkd->blkrd", p, vf).reshape(B, L, H * Dh)
+        return dense(cfg.d_model, "o_proj")(o)
+
+    def _decode_paged(
+        self, q, k, v, cos, sin, scale, dense, positions, block_tables
+    ):
+        """Paged-pool variant of the per-sample decode path (see _decode).
+
+        The cache collection holds ONE (num_blocks, block_size, KV, Dh)
+        K/V pool shared by all B rows; `block_tables` (B, nb) maps each
+        row's logical blocks onto it. Token at absolute position p of
+        row b writes to flat pool index
+        `block_tables[b, p // bs] * bs + p % bs`; invalid logical blocks
+        (table entry == num_blocks) and positions past the table push
+        the flat index out of bounds, where `mode="drop"` discards the
+        write. Attention gathers the row's logical K/V layout and masks
+        by absolute position, so dropped/garbage regions are never
+        attended (every key <= a live row's position sits in an
+        allocated block — the engine allocates before it writes)."""
+        from jax import lax  # noqa: F401 — parity with _decode's imports
+
+        from ..ops import gather_paged_kv
+
+        cfg = self.cfg
+        B, L, KV, Dh = k.shape
+        H = cfg.n_heads
+        M = cfg.max_seq_len
+        ck = self.variable("cache", "k", lambda: None)
+        cv = self.variable("cache", "v", lambda: None)
+        nblk, bs = ck.value.shape[0], ck.value.shape[1]
+        nb = block_tables.shape[1]
+
+        idx = positions.astype(jnp.int32)  # (B,) absolute start positions
+        pos = idx[:, None] + jnp.arange(L)[None, :]  # (B, L) absolute
+        safe = jnp.clip(pos, 0, M - 1)  # RoPE table bound; overshoot is
+        q = apply_rope_batched(q, cos[safe], sin[safe])  # dropped below
+        k = apply_rope_batched(k, cos[safe], sin[safe])
+
+        lb = pos // bs  # (B, L) logical block
+        off = pos % bs
+        phys = jnp.take_along_axis(
+            block_tables, jnp.clip(lb, 0, nb - 1), axis=1
+        )  # (B, L) physical block id, == nblk when unallocated
+        flat = jnp.where(lb < nb, phys * bs + off, nblk * bs)  # OOB sentinel
+        flat = flat.reshape(B * L)
+
+        def scatter(pool, upd):
+            flat_pool = pool.reshape(nblk * bs, KV, Dh)
+            flat_pool = flat_pool.at[flat].set(
+                upd.reshape(B * L, KV, Dh), mode="drop"
+            )
+            return flat_pool.reshape(nblk, bs, KV, Dh)
+
+        ck.value = scatter(ck.value, k)
+        cv.value = scatter(cv.value, v)
+
+        kf, vf = gather_paged_kv(ck.value, cv.value, block_tables)
+        Mb = nb * bs  # logical key span the tables cover (>= M)
+        key_pos = jnp.arange(Mb)
+        mask = key_pos[None, None, :] <= pos[:, :, None]  # (B, L, Mb)
+        rep = H // KV
+        qg = q.reshape(B, L, KV, rep, Dh)
+        s = jnp.einsum("blkrd,bmkd->bkrlm", qg, kf) * scale
         s = jnp.where(mask[:, None, None], s.astype(jnp.float32), -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(vf.dtype)
         o = jnp.einsum("bkrlm,bmkd->blkrd", p, vf).reshape(B, L, H * Dh)
@@ -295,11 +394,14 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, decode: bool = False, positions=None):
+    def __call__(
+        self, x, cos, sin, decode: bool = False, positions=None,
+        block_tables=None,
+    ):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), cos, sin, decode,
-            positions,
+            positions, block_tables,
         )
         mlp_cls = MoE if cfg.n_experts > 0 else MLP
         x = x + mlp_cls(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
@@ -310,7 +412,10 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False, positions=None):
+    def __call__(
+        self, tokens, decode: bool = False, positions=None,
+        block_tables=None,
+    ):
         """tokens: (B, L) int32 → logits (B, L, vocab) fp32.
 
         `decode=True` switches attention to the KV-cache path (flax
@@ -319,7 +424,11 @@ class TransformerLM(nn.Module):
         `models/generate.py` wraps the loop. `positions` ((B,) int32)
         selects PER-SAMPLE cache indices instead of the shared scalar
         index — the serve engine's slot-batch decode (`serve/`), where
-        each row advances from its own depth."""
+        each row advances from its own depth. `block_tables` ((B, nb)
+        int32, with `positions`) additionally switches the cache to the
+        serve engine's PAGED block pool (`serve/cache.py`): one
+        (num_blocks, block_size, kv_heads, head_dim) K/V pool per layer
+        shared by all rows, indexed through per-row block tables."""
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_embed"
@@ -336,7 +445,7 @@ class TransformerLM(nn.Module):
                 x = block_cls(cfg, name=f"layers_{i}")(x, cos, sin)
             else:
                 x = block_cls(cfg, name=f"layers_{i}")(
-                    x, cos, sin, decode, positions
+                    x, cos, sin, decode, positions, block_tables
                 )
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(

@@ -1,4 +1,4 @@
 """Pallas TPU kernels for the framework's hot ops."""
 
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import flash_attention, gather_paged_kv  # noqa: F401
 from .reference import dense_attention  # noqa: F401

@@ -802,3 +802,29 @@ def flash_attention(
         interpret = _interpret_default()
     o = _flash(_to_bh(q), _to_bh(k), _to_bh(v), scale, causal, bq, bk, interpret)
     return _from_bh(o, B, H)
+
+
+def gather_paged_kv(pool_k, pool_v, block_tables):
+    """Materialize each row's LOGICAL K/V layout from a paged block pool.
+
+    pool_k/pool_v: (num_blocks, block_size, KV, Dh) — the serve engine's
+    shared block pool (`serve/cache.py`); block_tables: (B, nb) int32
+    mapping row b's logical block j to a physical block id (entries ==
+    num_blocks mark unallocated logical blocks; the gather clamps them
+    to a real block and the caller's causal/length mask hides the
+    garbage, exactly like padded prefill positions). Returns
+    ((B, nb*block_size, KV, Dh), (B, nb*block_size, KV, Dh)) in logical
+    position order, so downstream attention indexes keys by absolute
+    position — the one seam a Pallas paged-attention kernel would
+    replace (today it lowers to an XLA gather feeding the cache-
+    attention einsum; the KV-head axis passes through untouched, so a
+    TP-sharded pool stays sharded through the gather).
+    """
+    nblk, bs, KV, Dh = pool_k.shape
+    B, nb = block_tables.shape
+
+    def one(pool):
+        g = pool[block_tables]  # (B, nb, bs, KV, Dh), OOB ids clamp
+        return g.reshape(B, nb * bs, KV, Dh)
+
+    return one(pool_k), one(pool_v)

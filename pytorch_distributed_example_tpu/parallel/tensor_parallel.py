@@ -179,3 +179,50 @@ def vocab_parallel_cross_entropy(
 
 # torch.distributed.tensor.parallel.loss_parallel-shaped alias
 loss_parallel = vocab_parallel_cross_entropy
+
+
+# ---------------------------------------------------------------------------
+# serve-engine decode placement (paged KV pool + replicated slot state)
+# ---------------------------------------------------------------------------
+
+
+def shard_kv_pool(tree, mesh, axis: str = "tp"):
+    """Place a paged KV pool tree (per layer (num_blocks, block_size,
+    kv_heads, head_dim) K/V — `serve/cache.py`) onto `mesh` with the
+    KV-HEAD axis sharded over ``axis``: each chip holds its heads' slice
+    of every block, the layout under which the block gather and the
+    cache-attention einsum partition cleanly and GSPMD inserts exactly
+    the per-block all-reduce Megatron TP implies (the ISSUE's
+    arxiv 2112.01075 discipline: blocks move between layouts without
+    ever materializing the replicated pool). Leaves whose KV-head dim
+    does not divide the axis (or non-pool leaves) replicate — the same
+    graceful degradation `sharding.spec_for` applies to params.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    size = dict(jmesh.shape)[axis]
+
+    def leaf(x):
+        spec = (
+            P(None, None, axis, None)
+            if getattr(x, "ndim", 0) == 4 and x.shape[2] % size == 0
+            else P()
+        )
+        return jax.device_put(x, NamedSharding(jmesh, spec))
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def replicate_tree(tree, mesh):
+    """Replicate every leaf of `tree` across `mesh` (the serve engine's
+    slot bookkeeping lanes: lengths/tokens/rngs are (S,)-shaped scalars
+    per slot — sharding them would cost a gather per readback)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(jmesh, P())), tree
+    )

@@ -1,17 +1,31 @@
 """Continuous-batching serve subsystem.
 
-The inference half of the north star: a slot-managed KV cache
-(`cache.py`), a single compiled batched decode step (`decode.py`), a
-request queue + scheduler with mid-stream retire-and-backfill
-(`engine.py`, `queue.py`), bucketed prefill shapes (`bucketing.py`),
-and a metrics block exposed over the debug HTTP frontend
-(`metrics.py`). `benchmarks/serve_bench.py` measures the goodput win
-over static-batch run-to-completion serving.
+The inference half of the north star: a PAGED block-pool KV cache with
+per-request block tables (`cache.py` — memory tracks live tokens, not
+slots x max_len), a single compiled paged decode step plus chunked
+prefill programs (`decode.py`), a scheduler with mid-stream
+retire-and-backfill, prefill/decode interleaving, pool-pressure
+preemption and optional tensor-parallel placement over a device mesh
+(`engine.py`), a bounded request queue with explicit shed (`queue.py`),
+bucketed prefill shapes (`bucketing.py`), and a metrics block — cache-
+pool utilization included — exposed over the debug HTTP frontend
+(`metrics.py`). `benchmarks/serve_bench.py` measures goodput vs a
+static-batch baseline, paged-vs-dense cache memory per request, chunked
+vs unchunked long-prompt-burst TTFT, and 1→N-chip TP goodput scaling.
 """
 
 from .bucketing import bucket_for, bucket_lengths  # noqa: F401
-from .cache import SlotKVCache  # noqa: F401
-from .decode import slot_programs  # noqa: F401
+from .cache import (  # noqa: F401
+    PagedKVCache,
+    SlotKVCache,
+    init_paged_cache,
+)
+from .decode import paged_programs, slot_programs  # noqa: F401
 from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
-from .queue import Completion, Request, RequestQueue  # noqa: F401
+from .queue import (  # noqa: F401
+    Completion,
+    QueueFullError,
+    Request,
+    RequestQueue,
+)

@@ -1,30 +1,45 @@
-"""Slot-based KV cache — the serve engine's memory manager.
+"""KV cache memory managers for the serve engine.
 
-One statically-shaped cache tree per layer, ``(slots, max_seq_len,
-kv_heads, head_dim)`` K/V (the flax "cache" collection with the batch
-axis reinterpreted as SLOTS), plus per-slot position/length vectors kept
-host-side. Because every shape is fixed at construction, the jitted
-decode step (`serve/decode.py`) compiles exactly once and is reused for
-the engine's whole lifetime — requests come and go by slot index, never
-by reshape.
+Two layouts live here:
 
-Lifecycle: `allocate()` hands out a free slot, `write_prefill()` lands a
-prefilled request into it (overwriting the slot's FULL buffer, so a
-retired request's stale K/V can never leak into its successor),
-`free()` returns it, `reset()` clears everything. The cache tree itself
-is reused/replaced functionally — callers own exactly one live version.
+* `PagedKVCache` — THE engine's cache (`serve/engine.py`): a fixed pool
+  of ``(num_blocks, block_size, kv_heads, head_dim)`` K/V blocks per
+  layer plus a per-slot block table mapping logical blocks to physical
+  ones. Blocks are allocated ON WRITE (as prefill chunks land and as
+  decode crosses block boundaries) and freed at retire, so cache memory
+  per request tracks LIVE tokens — not ``slots x max_seq_len`` the way
+  the dense layout does. Entries equal to ``num_blocks`` mark
+  unallocated logical blocks; the paged attention path
+  (`models/transformer.py::_decode_paged`) turns writes through them
+  into out-of-bounds scatter drops, which is how parked lanes and
+  padded chunks stay harmless. The pool is exhaustible by design: a
+  failed `ensure_blocks` is the engine's backpressure/preemption
+  signal.
+
+* `SlotKVCache` — the PR 4 dense per-slot layout, kept as the
+  reference/baseline the bench and the parity tests compare against:
+  one ``(slots, max_seq_len, kv_heads, head_dim)`` buffer per layer,
+  whole-buffer prefill-into-slot.
+
+Both keep per-slot lengths host-side and reuse/replace their device
+tree functionally — callers own exactly one live version.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..models.generate import init_cache
 
-__all__ = ["SlotKVCache", "land_slot"]
+__all__ = [
+    "SlotKVCache",
+    "PagedKVCache",
+    "init_paged_cache",
+    "land_slot",
+]
 
 
 def land_slot(tree, pre, slot):
@@ -124,4 +139,196 @@ class SlotKVCache:
             f"SlotKVCache(slots={self.slots}, "
             f"active={int(self._in_use.sum())}, "
             f"lengths={self.lengths.tolist()})"
+        )
+
+
+def init_paged_cache(model, num_blocks: int, block_size: int):
+    """Empty paged K/V pool tree for `model`: per layer one
+    (num_blocks, block_size, kv_heads, head_dim) K and V. Mirrors
+    `models.generate.init_cache`'s structure minus the scalar "index"
+    leaf (a shared pool has no per-row cursor)."""
+    import jax.numpy as jnp
+
+    cfg = model.cfg
+    KV, Dh = cfg.kv_heads, cfg.head_dim
+
+    def one_layer():
+        return {
+            "attn": {
+                "k": jnp.zeros((num_blocks, block_size, KV, Dh), cfg.dtype),
+                "v": jnp.zeros((num_blocks, block_size, KV, Dh), cfg.dtype),
+            }
+        }
+
+    return {f"layers_{i}": one_layer() for i in range(cfg.n_layers)}
+
+
+class PagedKVCache:
+    """Block-pool KV cache: slot bookkeeping + allocate-on-write blocks.
+
+    `tree` is the live pool tree (one (num_blocks, block_size, KV, Dh)
+    K/V pool per layer, shared by every slot); `block_tables` is the
+    HOST (slots, nb) int32 table the jitted programs consume per call
+    (entries == num_blocks mark unallocated logical blocks — tiny, and
+    it changes only at admission/growth/retire, so shipping it per step
+    is cheaper than donated-device choreography); `lengths` mirrors
+    per-slot depth for introspection. Blocks return to the free list at
+    `free()` (retire/preempt) in FIFO reuse order.
+    """
+
+    def __init__(
+        self,
+        model,
+        slots: int,
+        num_blocks: Optional[int] = None,
+        block_size: int = 16,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        cfg = model.cfg
+        M = cfg.max_seq_len
+        self.model = model
+        self.slots = slots
+        self.block_size = block_size
+        self.blocks_per_seq = -(-M // block_size)  # nb: ceil(M / bs)
+        if num_blocks is None:
+            # dense-equivalent capacity: every slot can hold max_seq_len.
+            # Size it DOWN (bench/production) to cap memory at expected
+            # live tokens and let backpressure/preemption absorb bursts.
+            num_blocks = slots * self.blocks_per_seq
+        if num_blocks < self.blocks_per_seq:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) cannot hold even one "
+                f"max-length request ({self.blocks_per_seq} blocks)"
+            )
+        self.num_blocks = num_blocks
+        self.invalid_block = num_blocks  # OOB sentinel the paged path drops
+        self.tree = init_paged_cache(model, num_blocks, block_size)
+        self.block_tables = np.full(
+            (slots, self.blocks_per_seq), self.invalid_block, np.int32
+        )
+        self.lengths = np.zeros((slots,), np.int32)
+        self._in_use = np.zeros((slots,), bool)
+        self._free_slots: List[int] = list(range(slots))
+        self._free_blocks: List[int] = list(range(num_blocks))
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+
+    # -- slot lifecycle ----------------------------------------------------
+    def allocate(self) -> Optional[int]:
+        """A free slot index (no blocks yet — those come on write), or
+        None when every slot is taken."""
+        if not self._free_slots:
+            return None
+        s = self._free_slots.pop(0)
+        self._in_use[s] = True
+        return s
+
+    def free(self, slot: int) -> int:
+        """Retire a slot: return its blocks to the pool and invalidate
+        its table row. Returns the number of blocks freed."""
+        if not self._in_use[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        n = len(self._slot_blocks[slot])
+        self._free_blocks.extend(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self.block_tables[slot, :] = self.invalid_block
+        self._in_use[slot] = False
+        self.lengths[slot] = 0
+        self._free_slots.append(slot)
+        return n
+
+    def reset(self) -> None:
+        """Free every slot and block. Device pool buffers are NOT
+        cleared — unallocated logical blocks are unreachable through the
+        tables, and a block's garbage is masked until overwritten."""
+        for s in range(self.slots):
+            if self._in_use[s]:
+                self.free(s)
+
+    # -- block plane -------------------------------------------------------
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold `tokens` positions."""
+        return -(-tokens // self.block_size)
+
+    def ensure_blocks(self, slot: int, upto_pos: int) -> bool:
+        """Grow `slot`'s table so position `upto_pos` is writable
+        (allocate-on-write). All-or-nothing: returns False — allocating
+        NOTHING — when the free list can't cover the growth; the engine
+        turns that into backpressure or preemption."""
+        if not self._in_use[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        if not 0 <= upto_pos < self.blocks_per_seq * self.block_size:
+            raise ValueError(
+                f"position {upto_pos} outside the slot's "
+                f"{self.blocks_per_seq}-block table"
+            )
+        have = len(self._slot_blocks[slot])
+        need = upto_pos // self.block_size + 1 - have
+        if need <= 0:
+            return True
+        if need > len(self._free_blocks):
+            return False
+        for j in range(have, have + need):
+            b = self._free_blocks.pop(0)
+            self._slot_blocks[slot].append(b)
+            self.block_tables[slot, j] = b
+        return True
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.slots) if self._in_use[s]]
+
+    @property
+    def occupancy(self) -> float:
+        return float(self._in_use.sum()) / self.slots
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_blocks)
+
+    @property
+    def live_blocks(self) -> int:
+        return self.num_blocks - len(self._free_blocks)
+
+    @property
+    def pool_utilization(self) -> float:
+        return self.live_blocks / self.num_blocks
+
+    @functools.cached_property
+    def bytes_per_block(self) -> int:
+        """HBM bytes one block pins across every layer (K + V)."""
+        cfg = self.model.cfg
+        itemsize = np.dtype(cfg.dtype).itemsize
+        return (
+            2 * cfg.n_layers * self.block_size * cfg.kv_heads
+            * cfg.head_dim * itemsize
+        )
+
+    @property
+    def bytes_live(self) -> int:
+        return self.live_blocks * self.bytes_per_block
+
+    @functools.cached_property
+    def dense_bytes_per_request(self) -> int:
+        """What ONE slot costs in the dense (slots, max_seq_len, ...)
+        layout — the paged-vs-dense comparison baseline."""
+        cfg = self.model.cfg
+        itemsize = np.dtype(cfg.dtype).itemsize
+        return (
+            2 * cfg.n_layers * cfg.max_seq_len * cfg.kv_heads
+            * cfg.head_dim * itemsize
+        )
+
+    def slot_blocks(self, slot: int) -> List[int]:
+        return list(self._slot_blocks[slot])
+
+    def __repr__(self) -> str:
+        return (
+            f"PagedKVCache(slots={self.slots}, "
+            f"blocks={self.live_blocks}/{self.num_blocks}, "
+            f"block_size={self.block_size}, "
+            f"active={int(self._in_use.sum())})"
         )

@@ -38,7 +38,7 @@ from typing import Optional
 from ..models.generate import init_cache, sample_logits
 from .cache import land_slot
 
-__all__ = ["slot_programs"]
+__all__ = ["slot_programs", "paged_programs"]
 
 
 @functools.lru_cache(maxsize=32)
@@ -111,3 +111,99 @@ def slot_programs(model, temperature: float, top_k: Optional[int]):
         )
 
     return prefill, write_slot, step
+
+
+@functools.lru_cache(maxsize=32)
+def paged_programs(model, temperature: float, top_k: Optional[int]):
+    """(prefill_chunk, first_token, attach, step) jitted quadruple for
+    the PAGED engine at the given sampling knobs.
+
+    Same hot-path discipline as `slot_programs`, adapted to the block
+    pool: the pool tree and the per-slot (lengths, last-token, rng)
+    lanes are device-resident and DONATED through every program; block
+    tables stay HOST-side numpy and ride in per call (tiny, mutated
+    only at admission/growth/retire — see `serve/cache.py`).
+
+    * ``prefill_chunk(params, tree, chunk (1, C), bt_row (1, nb),
+      start)`` — one prompt chunk through the paged decode path at
+      absolute offset `start`; returns (tree', logits (C, V)). Compiles
+      once per CHUNK length C: with `prefill_chunk_tokens` set that is
+      ONE program for every prompt; unchunked it is one per bucket,
+      exactly like PR 4.
+    * ``first_token(chunk_logits, end, seed)`` — sample the request's
+      first token from the TRUE prompt-end logits row (`end` indexes
+      within the final chunk, so padding never leaks) with the
+      per-request stream built from `seed` — mirrors `generate()`'s
+      prefill rng discipline (one split consumed).
+    * ``attach(lengths, tokens, rngs, slot, L, first, key)`` — fuse the
+      finished request's state lanes into the donated slot vectors (the
+      block table row was already built host-side chunk by chunk).
+    * ``step(params, tree, lengths, tokens, rngs, bt)`` — advance EVERY
+      slot one token through the paged attention path. Compiles ONCE
+      for the engine's lifetime; retired/prefilling slots ride along as
+      parked lanes whose table rows are all-invalid, so their garbage
+      writes are scatter-DROPPED (never in any live block) and their
+      sampled tokens are ignored by the scheduler.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    M = model.cfg.max_seq_len
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill_chunk(params, tree, chunk, bt_row, start):
+        logits, vars2 = model.apply(
+            {"params": params, "cache": tree}, chunk, decode=True,
+            positions=jnp.asarray(start, jnp.int32)[None],
+            block_tables=bt_row, mutable=["cache"],
+        )
+        return vars2["cache"], logits[0]  # (C, V)
+
+    @jax.jit
+    def first_token(chunk_logits, end, seed):
+        last = lax.dynamic_index_in_dim(
+            chunk_logits, end, axis=0, keepdims=False
+        )
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        first = sample_logits(last[None], sub, temperature, top_k)[0]
+        return first, key
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def attach(lengths, tokens, rngs, slot, length, first, key):
+        return (
+            lengths.at[slot].set(length),
+            tokens.at[slot].set(first),
+            rngs.at[slot].set(key),
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+    def step(params, tree, lengths, tokens, rngs, bt):
+        """One paged continuous-batching decode step over all S slots.
+
+        lengths: (S,) int32 current depths (= this step's write
+        positions); tokens: (S,) last emitted; rngs: (S, 2) per-slot
+        keys; bt: (S, nb) block tables. Returns
+        (tree', lengths', next_tokens (S,), rngs'). Parked lanes clamp
+        at M-1 (in-bounds RoPE/mask) and their invalid table rows drop
+        the write."""
+        split = jax.vmap(jax.random.split)(rngs)  # (S, 2, 2)
+        subs, new_rngs = split[:, 0], split[:, 1]
+        logits, vars2 = model.apply(
+            {"params": params, "cache": tree}, tokens[:, None],
+            decode=True, positions=lengths, block_tables=bt,
+            mutable=["cache"],
+        )
+        lg = logits[:, -1]  # (S, V)
+        nxt = jax.vmap(
+            lambda row, key: sample_logits(row, key, temperature, top_k)
+        )(lg, subs)
+        return (
+            vars2["cache"],
+            jnp.minimum(lengths + 1, M - 1),
+            nxt,
+            new_rngs,
+        )
+
+    return prefill_chunk, first_token, attach, step

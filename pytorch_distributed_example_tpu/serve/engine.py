@@ -1,21 +1,44 @@
-"""ServeEngine — continuous-batching scheduler over the slot KV cache.
+"""ServeEngine — continuous-batching scheduler over the paged KV cache.
 
 The serving loop the ROADMAP's "heavy traffic" north star needs:
-requests enter a queue (`serve/queue.py`), get admitted into cache
-slots as capacity frees up, and EVERY active slot advances one token
-per `step()` call through the single compiled decode program
-(`serve/decode.py`). When a request finishes (EOS or token budget) its
-slot is retired and immediately backfilled from the queue MID-STREAM —
-no run-to-completion barrier, which is exactly the multi-x goodput win
-`benchmarks/serve_bench.py` measures against the static-batch baseline.
+requests enter a bounded queue (`serve/queue.py`), get admitted into
+cache SLOTS whose memory is paged from a shared block pool
+(`serve/cache.py` — allocated on write, freed at retire, so HBM per
+request tracks live tokens), are prefilled in CHUNKS interleaved with
+decode (`prefill_chunk_tokens` bounds how much prompt work any single
+step may do, so a burst of long prompts cannot freeze in-flight
+decodes or starve short requests' TTFT), and then EVERY decoding slot
+advances one token per `step()` through the single compiled paged
+decode program (`serve/decode.py`). Retirement frees the slot AND its
+blocks and admission backfills MID-STREAM — no run-to-completion
+barrier.
 
-Fault surface: `serve.admit` fires before each prefill, `serve.step`
-before each decode batch (both in `faults.KNOWN_POINTS`). Transient
-faults (connection reset / dropped request) requeue the affected
-requests at the queue head and the engine carries on; because each
-request replays from its own seed, a greedy request's output is
-token-identical across any number of mid-stream requeues
-(`tests/test_serve.py` chaos cases).
+Pool pressure resolves by PREEMPTION, youngest-request-first: when a
+slot must grow into a block and the pool is dry, the youngest active
+request (possibly the grower itself) is evicted — blocks freed, request
+requeued at the head — and replays later from its own seed,
+token-identically. `submit()` refuses requests whose WORST-CASE
+footprint exceeds the whole pool, which makes the preemption loop
+deadlock-free: the oldest request can always claim enough blocks to
+finish. Admission additionally waits until the pool can hold a
+request's first chunk, so nothing thrashes at the door.
+
+Tensor-parallel decode: pass ``mesh=`` (a `DeviceMesh`/`jax.sharding.
+Mesh` with a ``tp`` axis) and the engine places params per
+`models.transformer.sharding_rules`, the block pool KV-head-sharded
+(`parallel.tensor_parallel.shard_kv_pool`), and the slot lanes
+replicated — the SAME jitted programs then run SPMD, with GSPMD
+inserting the one all-reduce per block pair that Megatron hand-codes.
+Slot bookkeeping and block tables stay host-side and identical on
+every chip.
+
+Fault surface: `serve.admit` before each admission, `serve.
+prefill_chunk` before each prompt chunk, `serve.step` before each
+decode batch (all in `faults.KNOWN_POINTS`). Transient faults requeue
+the affected requests at the queue head and the engine carries on;
+because each request replays from its own seed, a greedy request's
+output is token-identical across any number of mid-stream requeues
+(`tests/test_serve.py` / `tests/test_serve_paged.py` chaos cases).
 
 Synchronous single-owner design: one thread calls `submit()`/`step()`/
 `run()`; `ServeMetrics` is internally locked so the debug HTTP frontend
@@ -25,6 +48,7 @@ can snapshot concurrently.
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -32,10 +56,10 @@ import numpy as np
 from .. import faults
 from ..types import DistError
 from .bucketing import bucket_for, bucket_lengths
-from .cache import SlotKVCache
-from .decode import slot_programs
+from .cache import PagedKVCache
+from .decode import paged_programs
 from .metrics import ServeMetrics
-from .queue import Completion, Request, RequestQueue
+from .queue import Completion, QueueFullError, Request, RequestQueue
 
 __all__ = ["ServeEngine"]
 
@@ -43,6 +67,16 @@ __all__ = ["ServeEngine"]
 # transient taxonomy): injected connection resets and dropped requests.
 # DistError "error" faults and real programming errors propagate.
 _TRANSIENT = (ConnectionResetError, faults.FaultTimeout)
+
+
+@dataclass
+class _Prefill:
+    """A slot mid-prefill: `pos` is the next prompt position to chunk;
+    the request is not decoding (its lane stays parked) until the last
+    chunk lands and `attach` seeds its state lanes."""
+
+    req: Request
+    pos: int = 0
 
 
 class ServeEngine:
@@ -57,6 +91,12 @@ class ServeEngine:
         min_bucket: int = 16,
         clock=time.monotonic,
         metrics: Optional[ServeMetrics] = None,
+        block_size: int = 16,
+        pool_blocks: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        max_queue_depth: Optional[int] = None,
+        mesh=None,
+        tp_axis: str = "tp",
     ):
         self.model = model
         self.params = params["params"] if "params" in params else params
@@ -65,25 +105,62 @@ class ServeEngine:
         self.top_k = top_k
         self.eos_id = eos_id
         self.clock = clock
-        self.cache = SlotKVCache(model, slots)
-        self.queue = RequestQueue()
+        self.cache = PagedKVCache(
+            model, slots, num_blocks=pool_blocks, block_size=block_size
+        )
+        self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics or ServeMetrics(clock=clock, slots=slots)
         self.metrics.slots = slots
         self.buckets = bucket_lengths(self.cfg.max_seq_len, min_bucket)
-        self._prefill, self._write_slot, self._step = slot_programs(
-            model, temperature, top_k
-        )
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got "
+                f"{prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self.mesh = mesh
+        (
+            self._prefill_chunk,
+            self._first_token,
+            self._attach,
+            self._step,
+        ) = paged_programs(model, temperature, top_k)
         S = slots
         self._slot_req: List[Optional[Request]] = [None] * S
         self._slot_tokens: List[List[int]] = [[] for _ in range(S)]
+        self._prefilling: Dict[int, _Prefill] = {}
+        self._decoding: set = set()
         # device-resident per-slot state, donated through every step —
         # the per-token hot path touches the host only for the (S,)
-        # next-token readback (see serve/decode.py)
+        # next-token readback; block tables stay host-side numpy and
+        # ride into each program call (see serve/decode.py)
         import jax.numpy as jnp
 
         self._dev_lengths = jnp.zeros((S,), jnp.int32)
         self._dev_tokens = jnp.zeros((S,), jnp.int32)
         self._dev_rngs = jnp.zeros((S, 2), jnp.uint32)
+        if mesh is not None:
+            from ..models.transformer import sharding_rules
+            from ..parallel.sharding import shard_params
+            from ..parallel.tensor_parallel import (
+                replicate_tree,
+                shard_kv_pool,
+            )
+
+            self.params, _ = shard_params(
+                self.params, mesh,
+                sharding_rules(tp_axis=tp_axis, fsdp_axis=None),
+            )
+            self.cache.tree = shard_kv_pool(
+                self.cache.tree, mesh, axis=tp_axis
+            )
+            (
+                self._dev_lengths,
+                self._dev_tokens,
+                self._dev_rngs,
+            ) = replicate_tree(
+                (self._dev_lengths, self._dev_tokens, self._dev_rngs), mesh
+            )
         self.completions: Dict[str, Completion] = {}
 
     # -- admission ---------------------------------------------------------
@@ -93,8 +170,18 @@ class ServeEngine:
         max_new_tokens: int,
         rid: Optional[str] = None,
         seed: int = 0,
+        arrival_time: Optional[float] = None,
     ) -> str:
-        """Enqueue one generation request; returns its request id."""
+        """Enqueue one generation request; returns its request id.
+        Raises `QueueFullError` (counted in metrics as a shed) when
+        bounded admission is on and the queue is at depth.
+
+        `arrival_time` (engine-clock seconds) is trace-replay support:
+        a single-threaded replay driver can only call submit() between
+        steps, so stamping the clock would erase the queueing delay a
+        request already served before the driver got to it — pass the
+        TRUE front-door arrival and TTFT/e2e account for it (the static
+        baseline in serve_bench measures from trace arrival too)."""
         req = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=max_new_tokens,
@@ -110,27 +197,60 @@ class ServeEngine:
                 f"max_seq_len ({self.cfg.max_seq_len})"
             )
         bucket_for(L, self.buckets)  # raises when no bucket fits
-        req.arrival_time = self.clock()
-        self.queue.put(req)
+        worst = self.cache.blocks_for(L + max_new_tokens)
+        if worst > self.cache.num_blocks:
+            raise ValueError(
+                f"request needs up to {worst} blocks but the pool has "
+                f"{self.cache.num_blocks} (grow pool_blocks or shrink "
+                f"the request)"
+            )
+        req.arrival_time = (
+            self.clock() if arrival_time is None else arrival_time
+        )
+        try:
+            self.queue.put(req)
+        except QueueFullError:
+            self.metrics.record_shed()
+            raise
         self.metrics.record_submit(req.arrival_time)
         return req.rid
 
-    def _admit(self) -> None:
+    def _chunk_len(self, L: int) -> int:
+        """Upper bound on the first prefill program length for a prompt
+        of length L: the per-step token budget when chunking is on,
+        else the prompt's bucket (unchunked, per-bucket programs
+        exactly like PR 4). The admission gate sizes its first-chunk
+        block estimate from this."""
+        if self.prefill_chunk_tokens is not None:
+            return self.prefill_chunk_tokens
+        return bucket_for(L, self.buckets)
+
+    def _admit(self) -> int:
         """Backfill free slots from the queue head (continuous batching:
         called at the top of every step, so retirement and admission
-        interleave mid-stream)."""
-        import jax.numpy as jnp
-
+        interleave mid-stream). Admission stops when slots run out OR
+        the pool cannot hold the next request's first chunk — the
+        allocate-on-write backpressure gate. Returns the number of
+        requests admitted this round."""
+        admitted = 0
         while True:
             if not self.queue:
-                return
+                return admitted
+            head_len = self.queue.peek_len()
+            if head_len is None:
+                return admitted
+            need = self.cache.blocks_for(
+                min(self._chunk_len(head_len), head_len)
+            )
+            if need > self.cache.free_blocks:
+                return admitted  # pool backpressure: wait for retires
             slot = self.cache.allocate()
             if slot is None:
-                return
+                return admitted
             req = self.queue.pop()
             if req is None:  # racing submitter drained between checks
                 self.cache.free(slot)
-                return
+                return admitted
             try:
                 faults.fire("serve.admit", rid=req.rid)
             except _TRANSIENT:
@@ -141,40 +261,103 @@ class ServeEngine:
                 req.requeues += 1
                 self.queue.requeue_front(req)
                 self.metrics.record_requeue()
-                return
+                return admitted
+            self._slot_req[slot] = req
+            self._slot_tokens[slot] = []
+            self._prefilling[slot] = _Prefill(req)
+            self.metrics.record_admit()
+            admitted += 1
+
+    # -- chunked prefill ---------------------------------------------------
+    def _prefill_tick(self) -> None:
+        """Advance prefills. Unchunked: run EVERY pending prefill to
+        completion (one bucketed program each — PR 4 admission
+        semantics). Chunked: spend a per-step TOKEN BUDGET of
+        `prefill_chunk_tokens` program tokens, shortest-remaining-
+        prefill first — short prompts SHARE one step's budget (a
+        32-token budget prefills two 16-token prompts in the same step)
+        while a long prompt advances one budget-sized chunk per step,
+        interleaved with decode. A short arrival therefore never waits
+        behind a whole long prefill (the bounded-TTFT policy), and the
+        prefill service rate is budget/step rather than one program per
+        step. At least one program runs per tick, so a budget below the
+        smallest bucket still makes progress."""
+        import jax.numpy as jnp
+
+        budget = self.prefill_chunk_tokens
+        spent = 0
+        while self._prefilling:
+            slot = min(
+                self._prefilling,
+                key=lambda s: (
+                    len(self._prefilling[s].req.prompt)
+                    - self._prefilling[s].pos,
+                    self._prefilling[s].req.arrival_time,
+                ),
+            )
+            pf = self._prefilling[slot]
+            req = pf.req
             L = len(req.prompt)
-            Lb = bucket_for(L, self.buckets)
-            padded = np.zeros((1, Lb), np.int32)
-            padded[0, :L] = req.prompt
-            # prefill samples the first token on device off the request's
-            # seed (one readback for the scheduler); the fused write lands
-            # cache + state lanes in one donated program
-            pre_cache, _first_logits, first_dev, key = self._prefill(
-                self.params, jnp.asarray(padded), L, req.seed
+            if budget is None:
+                C = bucket_for(L, self.buckets)
+            else:
+                # program length this tick: the bucket covering what the
+                # remaining budget can spend, capped at the budget (so
+                # the compiled chunk shapes stay a bounded set: buckets
+                # <= budget, plus the budget itself)
+                want = max(1, min(L - pf.pos, budget - spent))
+                C = min(bucket_for(want, self.buckets), budget)
+                if spent and spent + C > budget:
+                    return  # budget spent: yield to decode
+            end = min(pf.pos + C, L)
+            if not self._ensure_or_preempt(slot, end - 1):
+                continue  # the prefilling request itself got evicted
+            try:
+                faults.fire("serve.prefill_chunk", rid=req.rid, pos=pf.pos)
+            except _TRANSIENT:
+                self._evict(slot, requeue_counter=True)
+                continue
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, : end - pf.pos] = req.prompt[pf.pos:end]
+            self.cache.tree, logits = self._prefill_chunk(
+                self.params,
+                self.cache.tree,
+                jnp.asarray(chunk),
+                self.cache.block_tables[slot : slot + 1],
+                pf.pos,
+            )
+            start = pf.pos
+            pf.pos = end
+            spent += C
+            if end < L:
+                if budget is not None and spent >= budget:
+                    return  # budget spent: yield to decode
+                continue
+            # final chunk: sample the first token at the TRUE prompt end
+            # and fuse the request's lanes into the donated slot vectors
+            first_dev, key = self._first_token(
+                logits, (L - 1) - start, req.seed
             )
             first = int(first_dev)
             (
-                self.cache.tree,
                 self._dev_lengths,
                 self._dev_tokens,
                 self._dev_rngs,
-            ) = self._write_slot(
-                self.cache.tree,
+            ) = self._attach(
                 self._dev_lengths,
                 self._dev_tokens,
                 self._dev_rngs,
-                pre_cache,
                 slot,
                 L,
                 first_dev,
                 key,
             )
             self.cache.lengths[slot] = L  # host mirror for introspection
-            self._slot_req[slot] = req
+            del self._prefilling[slot]
+            self._decoding.add(slot)
             self._slot_tokens[slot] = [first]
             now = self.clock()
             req.first_token_time = now
-            self.metrics.record_admit()
             if (self.eos_id is not None and first == self.eos_id) or (
                 req.max_new_tokens == 1
             ):
@@ -185,22 +368,99 @@ class ServeEngine:
                     if self.eos_id is not None and first == self.eos_id
                     else "length",
                 )
+            if budget is not None and spent >= budget:
+                return  # budget spent: yield to decode
+
+    # -- pool pressure -----------------------------------------------------
+    def _ensure_or_preempt(self, slot: int, upto_pos: int) -> bool:
+        """Grow `slot`'s block table to cover `upto_pos`, evicting the
+        YOUNGEST active request (by arrival) while the pool is dry.
+        Returns False when the grower itself was the youngest and got
+        evicted. Deadlock-free: submit() guarantees any single request's
+        worst case fits the pool, so the oldest request always wins."""
+        while not self.cache.ensure_blocks(slot, upto_pos):
+            victims = [
+                s
+                for s in range(self.cache.slots)
+                if self._slot_req[s] is not None
+            ]
+            victim = max(
+                victims, key=lambda s: self._slot_req[s].arrival_time
+            )
+            self._evict(victim, requeue_counter=False)
+            self.metrics.record_preempt()
+            if victim == slot:
+                return False
+        return True
+
+    def _evict(self, slot: int, requeue_counter: bool) -> None:
+        """Push a slot's request back to the queue HEAD and free the
+        slot + its blocks (preemption and transient-chunk-fault path).
+        The replay is token-identical — per-request seeds."""
+        req = self._slot_req[slot]
+        req.requeues += 1
+        req.first_token_time = None
+        self._slot_req[slot] = None
+        self._slot_tokens[slot] = []
+        self._prefilling.pop(slot, None)
+        self._decoding.discard(slot)
+        self.queue.requeue_front(req)
+        self.cache.free(slot)
+        if requeue_counter:
+            self.metrics.record_requeue()
 
     # -- decode ------------------------------------------------------------
     def step(self) -> bool:
-        """One engine iteration: admit, advance every active slot one
-        token, retire finished requests. Returns True while work remains
-        (active slots or queued requests)."""
+        """One engine iteration: admit, advance prefills (one chunk when
+        chunking is on), grow/preempt blocks, advance every decoding
+        slot one token, retire finished requests. Returns True while
+        work remains (active slots, prefills, or queued requests)."""
         self._admit()
-        active = self.cache.active_slots
-        self.metrics.record_step(self.queue.depth, len(active))
-        if not active:
-            return bool(self.queue)
+        self.metrics.record_step(
+            self.queue.depth, len(self.cache.active_slots)
+        )
+        self.metrics.record_pool(
+            self.cache.live_blocks,
+            self.cache.num_blocks,
+            self.cache.bytes_per_block,
+            len(self._decoding) + len(self._prefilling),
+            self.cache.dense_bytes_per_request,
+        )
+        while True:
+            self._prefill_tick()
+            # a prefill-finish retire (eos / budget 1) frees a slot
+            # MID-STEP; unchunked keeps PR 4's semantics by backfilling
+            # and prefilling it in the same iteration. Chunked mode
+            # still grants the slot (next step's tick prefills it) but
+            # spends no further chunk budget.
+            if self._admit() == 0 or self.prefill_chunk_tokens is not None:
+                break
+        if not self._decoding:
+            return bool(self._prefilling) or bool(self.queue)
         try:
-            faults.fire("serve.step", n_active=len(active))
+            faults.fire("serve.step", n_active=len(self._decoding))
         except _TRANSIENT:
             self.requeue_inflight()
             return True
+        # allocate-on-write: every decoding slot must own the block its
+        # next token lands in BEFORE the batched write (preemption may
+        # shrink the decoding set here)
+        for s in sorted(self._decoding):
+            if s not in self._decoding:  # evicted by an earlier growth
+                continue
+            self._ensure_or_preempt(s, int(self.cache.lengths[s]))
+        active = sorted(self._decoding)
+        if not active:
+            return bool(self._prefilling) or bool(self.queue)
+        # a MID-PREFILL slot's lane is parked but its table row already
+        # holds real blocks (chunks land as they arrive) — hand the step
+        # a view with those rows invalidated so the parked lane's
+        # garbage write drops instead of scattering into the request's
+        # own block 0. Retired rows are already all-invalid via free().
+        bt = self.cache.block_tables
+        if self._prefilling:
+            bt = bt.copy()
+            bt[sorted(self._prefilling)] = self.cache.invalid_block
         (
             self.cache.tree,
             self._dev_lengths,
@@ -212,6 +472,7 @@ class ServeEngine:
             self._dev_lengths,
             self._dev_tokens,
             self._dev_rngs,
+            bt,
         )
         self._dev_tokens = nxt
         nxt_h = np.asarray(nxt)  # the hot path's one host readback
@@ -225,7 +486,11 @@ class ServeEngine:
                 self._retire(s, now, "eos")
             elif len(self._slot_tokens[s]) >= req.max_new_tokens:
                 self._retire(s, now, "length")
-        return bool(self.cache.active_slots) or bool(self.queue)
+        return (
+            bool(self._decoding)
+            or bool(self._prefilling)
+            or bool(self.queue)
+        )
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, Completion]:
         """Drive step() until the queue and slots drain (or max_steps);
@@ -263,15 +528,15 @@ class ServeEngine:
         self.metrics.record_complete(now, n, comp.ttft_s, tpot, comp.e2e_s)
         self._slot_req[slot] = None
         self._slot_tokens[slot] = []
-        self.cache.free(slot)
+        self._decoding.discard(slot)
+        self.cache.free(slot)  # slot AND its blocks return to the pool
 
     def requeue_inflight(self) -> int:
-        """Drain every in-flight request back to the queue HEAD in
-        ARRIVAL order (slot index says nothing about age once backfill
-        has recycled slots) and free the slots — the mid-stream
-        kill/restart path. Each request replays from scratch off its own
-        seed, so greedy outputs are unchanged by any number of
-        requeues."""
+        """Drain every in-flight request (decoding AND mid-prefill) back
+        to the queue HEAD in ARRIVAL order and free slots + blocks — the
+        mid-stream kill/restart path. Each request replays from scratch
+        off its own seed, so greedy outputs are unchanged by any number
+        of requeues."""
         inflight = sorted(
             (
                 s
@@ -286,6 +551,8 @@ class ServeEngine:
             req.first_token_time = None
             self._slot_req[s] = None
             self._slot_tokens[s] = []
+            self._prefilling.pop(s, None)
+            self._decoding.discard(s)
             self.queue.requeue_front(req)
             self.cache.free(s)
         self.metrics.record_requeue(len(inflight))

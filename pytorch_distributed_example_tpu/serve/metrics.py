@@ -1,12 +1,15 @@
 """ServeMetrics — the engine's observability block.
 
 Tracks queue depth, slot occupancy, TTFT / TPOT / end-to-end latency
-percentiles, and tokens/s goodput (completed-request tokens only — a
+percentiles, tokens/s goodput (completed-request tokens only — a
 request killed mid-stream contributes nothing until its replay
 finishes, which is what makes the number "goodput" rather than raw
-throughput). A `clock` injection point keeps the accounting testable
-with a fake clock; `snapshot()` returns plain JSON for the debug HTTP
-frontend (`utils/debug_http.py` route ``/serve``).
+throughput), bounded-admission sheds, and PAGED CACHE POOL utilization
+(live blocks / total blocks, live cache bytes per live request vs the
+dense per-slot layout's constant — the runtime-observable form of the
+paged cache's memory claim). A `clock` injection point keeps the
+accounting testable with a fake clock; `snapshot()` returns plain JSON
+for the debug HTTP frontend (`utils/debug_http.py` route ``/serve``).
 """
 
 from __future__ import annotations
@@ -48,7 +51,18 @@ class ServeMetrics:
         self.admitted = 0  # admission ATTEMPTS (a requeued request re-admits)
         self.completed = 0
         self.requeued = 0
+        self.shed = 0  # bounded-admission rejections (never enqueued)
+        self.preempted = 0  # pool-pressure evictions (requeued, will replay)
         self.steps = 0
+        # paged-pool gauges (last observation) + time-mean accumulators
+        self.pool_blocks_live = 0
+        self.pool_blocks_total = 0
+        self.pool_bytes_per_block = 0
+        self.dense_bytes_per_request = 0
+        self._pool_util_sum = 0.0
+        self._pool_samples = 0
+        self._bytes_per_req_sum = 0.0
+        self._bytes_per_req_samples = 0
         self.tokens_completed = 0
         self.queue_depth = 0
         self.slots_active = 0
@@ -83,6 +97,42 @@ class ServeMetrics:
     def record_requeue(self, n: int = 1) -> None:
         with self._lock:
             self.requeued += n
+
+    def record_shed(self) -> None:
+        """One bounded-admission rejection (QueueFullError at submit)."""
+        with self._lock:
+            self.shed += 1
+
+    def record_preempt(self, n: int = 1) -> None:
+        """Pool-pressure evictions: requests requeued to free blocks."""
+        with self._lock:
+            self.preempted += n
+
+    def record_pool(
+        self,
+        blocks_live: int,
+        blocks_total: int,
+        bytes_per_block: int,
+        live_requests: int,
+        dense_bytes_per_request: int,
+    ) -> None:
+        """Per-step paged-pool observation. Gauges keep the LAST value;
+        utilization and bytes-per-live-request also accumulate a
+        time-mean (bytes/request samples only when requests are live,
+        so idle steps don't dilute the memory claim)."""
+        with self._lock:
+            self.pool_blocks_live = blocks_live
+            self.pool_blocks_total = blocks_total
+            self.pool_bytes_per_block = bytes_per_block
+            self.dense_bytes_per_request = dense_bytes_per_request
+            if blocks_total:
+                self._pool_util_sum += blocks_live / blocks_total
+                self._pool_samples += 1
+            if live_requests > 0:
+                self._bytes_per_req_sum += (
+                    blocks_live * bytes_per_block / live_requests
+                )
+                self._bytes_per_req_samples += 1
 
     def record_complete(
         self,
@@ -136,11 +186,21 @@ class ServeMetrics:
             occupancy = (
                 self._occupancy_steps / self.steps if self.steps else 0.0
             )
+            mean_util = (
+                self._pool_util_sum / self._pool_samples
+                if self._pool_samples else 0.0
+            )
+            mean_bpr = (
+                self._bytes_per_req_sum / self._bytes_per_req_samples
+                if self._bytes_per_req_samples else 0.0
+            )
             snap = {
                 "submitted": self.submitted,
                 "admitted": self.admitted,
                 "completed": self.completed,
                 "requeued": self.requeued,
+                "shed": self.shed,
+                "preempted": self.preempted,
                 "steps": self.steps,
                 "queue_depth": self.queue_depth,
                 "slots": self.slots,
@@ -148,6 +208,22 @@ class ServeMetrics:
                 "mean_occupancy": round(occupancy, 4),
                 "tokens_completed": self.tokens_completed,
                 "latency": lat,
+                "cache_pool": {
+                    "blocks_live": self.pool_blocks_live,
+                    "blocks_total": self.pool_blocks_total,
+                    "utilization": round(
+                        self.pool_blocks_live / self.pool_blocks_total, 4
+                    ) if self.pool_blocks_total else 0.0,
+                    "mean_utilization": round(mean_util, 4),
+                    "bytes_live": (
+                        self.pool_blocks_live * self.pool_bytes_per_block
+                    ),
+                    "bytes_per_live_request_mean": round(mean_bpr, 1),
+                    "dense_bytes_per_request": self.dense_bytes_per_request,
+                    "dense_reduction_x": round(
+                        self.dense_bytes_per_request / mean_bpr, 2
+                    ) if mean_bpr else 0.0,
+                },
             }
         snap["goodput_tokens_per_sec"] = round(
             self.goodput_tokens_per_sec(), 3

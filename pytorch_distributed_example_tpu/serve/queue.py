@@ -4,6 +4,12 @@ Thread-safe FIFO of `Request`s. The engine pops from the head when a
 slot frees up (continuous batching backfill); transiently-failed
 admissions and requeued in-flight work go back to the FRONT so a fault
 never reorders a request behind traffic that arrived after it.
+
+Admission is BOUNDED when `max_depth` is set: a `put()` into a full
+queue raises `QueueFullError` (explicit shed — the caller sees the
+rejection and the engine counts it) instead of growing without limit
+under overload. Fault-recovery requeues (`requeue_front`) are exempt:
+work the engine already accepted is never shed by its own retry path.
 """
 
 from __future__ import annotations
@@ -16,7 +22,15 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["Request", "Completion", "RequestQueue"]
+from ..types import DistError
+
+__all__ = ["Request", "Completion", "RequestQueue", "QueueFullError"]
+
+
+class QueueFullError(DistError):
+    """Bounded admission shed: the queue is at `max_depth` and this
+    request was REJECTED (never enqueued). Callers retry later or give
+    up; the engine's metrics count every shed."""
 
 _ids = itertools.count()
 
@@ -57,12 +71,23 @@ class Completion:
 
 
 class RequestQueue:
-    def __init__(self):
+    def __init__(self, max_depth: Optional[int] = None):
+        if max_depth is not None and max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
         self._q: deque = deque()
         self._lock = threading.Lock()
 
     def put(self, req: Request) -> None:
         with self._lock:
+            if (
+                self.max_depth is not None
+                and len(self._q) >= self.max_depth
+            ):
+                raise QueueFullError(
+                    f"queue full (max_depth={self.max_depth}); "
+                    f"request {req.rid} shed"
+                )
             self._q.append(req)
 
     def requeue_front(self, req: Request) -> None:
@@ -73,6 +98,13 @@ class RequestQueue:
     def pop(self) -> Optional[Request]:
         with self._lock:
             return self._q.popleft() if self._q else None
+
+    def peek_len(self) -> Optional[int]:
+        """Prompt length of the HEAD request (None when empty) — the
+        engine's admission gate sizes the first prefill chunk from it
+        without popping."""
+        with self._lock:
+            return len(self._q[0].prompt) if self._q else None
 
     @property
     def depth(self) -> int:
