@@ -13,6 +13,11 @@ Measures, for a synthetic param tree of N MB across many leaves:
   * reducer_ms  — Reducer.reduce(grads) wall time (eager path)
   * backend_ms  — one pre-compiled whole-tree allreduce of the same
                   payload (the floor the eager path dispatches against)
+  * quant_ms    — Reducer.reduce with the blockwise wire-quantized
+                  bucket hook (`blockwise_quant_hook(...).for_reducer`,
+                  int8 wire both phases + host-side error feedback):
+                  the bucket path's quantized-dispatch overhead next to
+                  its plain dispatch, same buckets
 
 Usage: python benchmarks/reducer_bench.py [--mb 1,8,32] [--leaves 64]
 """
@@ -78,6 +83,28 @@ def main():
             run_reducer()
         reducer_ms = (time.perf_counter() - t0) / args.iters * 1e3
 
+        # same buckets through the wire-quantized hook (int8 wire)
+        from pytorch_distributed_example_tpu.parallel import (
+            blockwise_quant_hook,
+        )
+
+        qreducer = Reducer(
+            process_group=g,
+            comm_hook=blockwise_quant_hook(bits=8).for_reducer(g),
+        )
+
+        def run_quant():
+            out = qreducer.reduce(grads)
+            jax.block_until_ready(out)
+            return out
+
+        for _ in range(args.warmup):
+            run_quant()
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            run_quant()
+        quant_ms = (time.perf_counter() - t0) / args.iters * 1e3
+
         # floor: the same PER-RANK payload as ONE pre-built DistTensor
         # allreduce (flatten cost excluded — that is precisely the eager
         # path's tax). One rank's slice only: the grads leaves are
@@ -105,6 +132,12 @@ def main():
                 backend_ms=round(backend_ms, 2),
                 overhead_x=round(reducer_ms / backend_ms, 2)
                 if backend_ms
+                else 0.0,
+                quant_ms=round(quant_ms, 2),
+                # same convention as overhead_x: measured / reference,
+                # > 1 means the quantized bucket path is slower
+                quant_overhead_x=round(quant_ms / reducer_ms, 2)
+                if reducer_ms
                 else 0.0,
                 leaves=args.leaves,
                 world=tdx.get_world_size(),

@@ -76,6 +76,19 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # quantized all-reduce wire rows (ISSUE 7): f32/bf16/int8
+            # payload bandwidth + analytic wire bytes; CPU acceptance is
+            # the wire-bytes accounting, TPU the measured ratio
+            "allreduce_quant",
+            [sys.executable, "benchmarks/allreduce_bw.py", "--op", "quant"]
+            + (
+                ["--max-mb", "1", "--iters", "3", "--warmup", "1"]
+                if q
+                else ["--max-mb", "64"]
+            ),
+            {},
+        ),
+        (
             "resnet_ddp",
             [sys.executable, "benchmarks/resnet_ddp.py"]
             + (["--steps", "5", "--warmup", "2", "--batch", "32"] if q else []),
@@ -147,6 +160,19 @@ def _jobs(quick: bool):
                 ["--preset", "small", "--requests", "24", "--slots", "8"]
                 if q
                 else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            # fixed-pool-bytes concurrency, int8 KV vs f32 (ISSUE 7):
+            # >= 1.8x admitted-slots target + greedy match-rate floor
+            "serve_quant_capacity",
+            [sys.executable, "benchmarks/serve_bench.py", "--trace",
+             "capacity"]
+            + (
+                ["--preset", "tiny", "--requests", "16"]
+                if q
+                else ["--preset", "small", "--requests", "32"]
             ),
             {},
         ),

@@ -37,9 +37,20 @@ the block pool sharded on the KV-head axis, slot lanes replicated) —
 goodput scaling 1→N chips. On a CPU host it self-provisions virtual
 devices (wiring smoke); the measurement row is the TPU run.
 
+`--trace capacity` is the int8-KV row (ROADMAP item 2): the SAME pool
+BYTES provisioned once as an f32 block pool and once as the int8+scales
+pool (`kv_quant=True` — ~(4/(1+4/head_dim))x the blocks), replaying a
+burst of mid-size requests with slots unbounded so the POOL is the
+binding constraint. Figure of merit: peak concurrently-admitted
+requests int8 vs f32 (target ≥1.8x) with the greedy token match rate
+vs the f32 run reported alongside (≥0.99 floor — quantized decode must
+not change what gets served). `--kv-quant` also flips the int8 cache
+on for the other traces (the TPU goodput-at-int8 row).
+
 Usage: python benchmarks/serve_bench.py [--preset small|base]
     [--slots 8] [--requests 48] [--rate 0] [--seed 0] [--bf16]
-    [--trace bimodal|longburst] [--prefill-chunk 32] [--tp N]
+    [--trace bimodal|longburst|capacity] [--prefill-chunk 32] [--tp N]
+    [--kv-quant]
 
 Measured (CPU fallback, defaults): engine 318.8 tok/s vs static 102.5 —
 3.1x goodput, p99 TTFT 4.1 s vs 18.9 s. Caveat: `--bf16` on the CPU
@@ -192,9 +203,16 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument(
-        "--trace", choices=["bimodal", "longburst"], default="bimodal",
+        "--trace", choices=["bimodal", "longburst", "capacity"],
+        default="bimodal",
         help="bimodal: goodput vs static (PR 4 row); longburst: "
-        "chunked-vs-unchunked short-class p99 TTFT",
+        "chunked-vs-unchunked short-class p99 TTFT; capacity: "
+        "fixed-pool-bytes concurrency, int8 KV vs f32 (ISSUE 7 row)",
+    )
+    ap.add_argument(
+        "--kv-quant", action="store_true",
+        help="run the engine with the int8 paged KV cache (capacity "
+        "trace runs BOTH modes regardless)",
     )
     ap.add_argument(
         "--prefill-chunk", type=int, default=32,
@@ -264,6 +282,121 @@ def main():
         jnp.asarray(gen.integers(0, cfg.vocab_size, (1, 8)), jnp.int32),
     )
 
+    if args.trace == "capacity":
+        from benchmarks.common import chain_pretrain
+        from pytorch_distributed_example_tpu.serve.cache import PagedKVCache
+
+        n = args.requests
+        bs = 8
+        # The bimodal trace (the acceptance trace), with prompt CONTENT
+        # drawn from the deterministic bigram chain the model is briefly
+        # PRETRAINED on (`chain_pretrain` — shared with the int8-KV
+        # parity tests; see its docstring for why the match rate is
+        # meaningless on random-init weights), trained at the FULL
+        # length the trace decodes to.
+        cap_traffic = make_traffic(n, 0.0, args.seed)
+        worst = max(t[1] + t[2] for t in cap_traffic)
+        cap_params, chain, loss = chain_pretrain(
+            model, params,
+            train_len=min(worst + 1, cfg.max_seq_len),
+            seed=args.seed + 1,
+        )
+        cap_prompts = [
+            chain(int(gen.integers(0, 10**9)), t[1]) for t in cap_traffic
+        ]
+
+        # ONE pool-byte budget, two layouts: f32 sized to ~3 concurrent
+        # worst-case requests, int8 given exactly the same bytes.
+        # conservative_admission reserves each request's worst case, so
+        # peak concurrency IS pool capacity (no preemption churn).
+        probe_f = PagedKVCache(model, slots=1, block_size=bs)
+        probe_q = PagedKVCache(
+            model, slots=1, block_size=bs, quantized=True
+        )
+        blocks_f = max(2 * -(-worst // bs), probe_f.blocks_per_seq)
+        pool_bytes = blocks_f * probe_f.bytes_per_block
+        blocks_q = max(
+            pool_bytes // probe_q.bytes_per_block, probe_q.blocks_per_seq
+        )
+
+        def replay_cap(quant, blocks):
+            warm = ServeEngine(
+                model, cap_params, slots=n, min_bucket=8, block_size=bs,
+                pool_blocks=blocks, kv_quant=quant,
+                prefill_chunk_tokens=args.prefill_chunk,
+                conservative_admission=True,
+            )
+            for p in cap_prompts:
+                warm.submit(p, 2)
+            warm.run(max_steps=200 * n)
+            eng, makespan = run_engine(
+                model, cap_params, cap_traffic, cap_prompts, n,
+                block_size=bs, pool_blocks=blocks, kv_quant=quant,
+                prefill_chunk_tokens=args.prefill_chunk,
+                conservative_admission=True,
+            )
+            assert eng.metrics.completed == n
+            return eng, makespan
+
+        eng_f, span_f = replay_cap(False, blocks_f)
+        eng_q, span_q = replay_cap(True, int(blocks_q))
+        snap_f = eng_f.metrics.snapshot()
+        snap_q = eng_q.metrics.snapshot()
+        matched = total = diverged = 0
+        for i in range(n):
+            a = eng_f.completions[f"r{i}"].tokens
+            b = eng_q.completions[f"r{i}"].tokens
+            matched += sum(int(x == y) for x, y in zip(a, b))
+            total += len(a)
+            diverged += int(a != b)
+        peak_f = snap_f["peak_slots_active"]
+        peak_q = snap_q["peak_slots_active"]
+        # the figure of merit is pool capacity, so the trace must not be
+        # the binding constraint: if the int8 run's peak concurrency hit
+        # the request count, the reported ratio is only a LOWER bound
+        saturated = peak_q >= n
+        if saturated:
+            print(
+                f"WARNING: int8 peak concurrency hit --requests ({n}); "
+                f"admitted_x is a lower bound — rerun with more requests",
+                file=sys.stderr,
+            )
+        useful = sum(t[2] for t in cap_traffic)
+        rec = emit(
+            "serve_quant_capacity_admitted_x",
+            peak_q / max(peak_f, 1),
+            "x",
+            peak_concurrent_f32=peak_f,
+            peak_concurrent_int8=peak_q,
+            int8_peak_saturated_by_trace=saturated,
+            target_admitted_x=1.8,
+            greedy_match_rate=round(matched / max(total, 1), 4),
+            match_rate_floor=0.99,
+            diverged_requests=diverged,
+            pretrain_loss=round(float(loss), 4),
+            pool_bytes=int(pool_bytes),
+            pool_blocks_f32=int(blocks_f),
+            pool_blocks_int8=int(blocks_q),
+            bytes_per_block_f32=probe_f.bytes_per_block,
+            bytes_per_block_int8=probe_q.bytes_per_block,
+            scale_bytes_per_block=probe_q.scale_bytes_per_block,
+            effective_slots_f32=snap_f["cache_pool"]["effective_slots"],
+            effective_slots_int8=snap_q["cache_pool"]["effective_slots"],
+            wire_dtype_int8=snap_q["cache_pool"]["wire_dtype"],
+            goodput_f32_tokens_per_sec=round(useful / span_f, 3),
+            goodput_int8_tokens_per_sec=round(useful / span_q, 3),
+            requests=n,
+            block_size=bs,
+            preset=args.preset,
+            dtype=str(jnp.dtype(cfg.dtype).name),
+            platform=jax.devices()[0].platform,
+            device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+            timing="readback_barrier",
+        )
+        if on_tpu():
+            persist_result("serve_quant_capacity", rec)
+        return
+
     if args.trace == "longburst":
         n_long = max(2, args.requests // 8)
         n_short = args.requests - n_long
@@ -276,14 +409,14 @@ def main():
         def replay(chunk):
             warm = ServeEngine(
                 model, params, slots=args.slots, min_bucket=8,
-                prefill_chunk_tokens=chunk,
+                prefill_chunk_tokens=chunk, kv_quant=args.kv_quant,
             )
             for p in lb_prompts:
                 warm.submit(p, 2)
             warm.run(max_steps=200 * len(lb))
             eng, makespan = run_engine(
                 model, params, lb, lb_prompts, args.slots,
-                prefill_chunk_tokens=chunk,
+                prefill_chunk_tokens=chunk, kv_quant=args.kv_quant,
             )
             assert eng.metrics.completed == len(lb)
             ttft = [
@@ -345,13 +478,15 @@ def main():
 
         def replay_tp(mesh_):
             warm = ServeEngine(
-                model, params, slots=args.slots, min_bucket=8, mesh=mesh_
+                model, params, slots=args.slots, min_bucket=8, mesh=mesh_,
+                kv_quant=args.kv_quant,
             )
             for p in prompts:
                 warm.submit(p, 2)
             warm.run(max_steps=200 * len(traffic))
             eng, makespan = run_engine(
-                model, params, traffic, prompts, args.slots, mesh=mesh_
+                model, params, traffic, prompts, args.slots, mesh=mesh_,
+                kv_quant=args.kv_quant,
             )
             assert eng.metrics.completed == args.requests
             return useful_tokens / makespan
@@ -379,7 +514,8 @@ def main():
         return
 
     # -- warm both regimes' compiles OUTSIDE the timed windows ------------
-    warm = ServeEngine(model, params, slots=args.slots, min_bucket=8)
+    warm = ServeEngine(model, params, slots=args.slots, min_bucket=8,
+                       kv_quant=args.kv_quant)
     for t, p in zip(traffic, prompts):  # touches every prefill bucket
         warm.submit(p, 2)
     warm.run(max_steps=10 * args.requests)
@@ -391,7 +527,8 @@ def main():
 
     # -- timed replays ----------------------------------------------------
     engine, engine_makespan = run_engine(
-        model, params, traffic, prompts, args.slots
+        model, params, traffic, prompts, args.slots,
+        kv_quant=args.kv_quant,
     )
     assert engine.metrics.completed == args.requests
     static_req, static_makespan = run_static(
@@ -436,6 +573,7 @@ def main():
         ],
         cache_dense_reduction_x=snap["cache_pool"]["dense_reduction_x"],
         cache_pool_mean_utilization=snap["cache_pool"]["mean_utilization"],
+        cache_wire_dtype=snap["cache_pool"]["wire_dtype"],
         max_seq=max_seq,
         provisioning="trace-exact" if max_seq == trace_max else "window",
         dtype=str(jnp.dtype(cfg.dtype).name),

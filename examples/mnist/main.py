@@ -87,6 +87,11 @@ class Trainer:
             return jnp.stack([(ce * w).sum(), (correct * w).sum(), w.sum()])
 
         self.train_step = ddp.make_train_step(optimizer, loss_fn, has_rng=True)
+        # stateful comm hooks (PowerSGD / blockwise quant error
+        # feedback) thread a state pytree through the compiled step
+        self.hook_state = None
+        if hasattr(self.train_step, "init_hook_state"):
+            self.hook_state = self.train_step.init_hook_state(ddp.params)
         # --steps-per-call K: K full optimizer steps fused (unrolled)
         # into one compiled program — identical math to K sequential
         # steps (tests/test_ddp.py pins it), host dispatch paid once per
@@ -148,19 +153,28 @@ class Trainer:
                     pending = []
                 continue
             self.rng, sub = _split(self.rng)
-            self.params, self.opt_state, loss = self.train_step(
-                self.params, self.opt_state, xs, ys, sub
-            )
+            loss = self._run_single(xs, ys, sub)
             avg.update(float(loss), xs.shape[0])
             seen += xs.shape[0]
         for xs, ys in pending:  # ragged tail: single-step fallback
             self.rng, sub = _split(self.rng)
-            self.params, self.opt_state, loss = self.train_step(
-                self.params, self.opt_state, xs, ys, sub
-            )
+            loss = self._run_single(xs, ys, sub)
             avg.update(float(loss), xs.shape[0])
             seen += xs.shape[0]
         return avg.average, seen
+
+    def _run_single(self, xs, ys, sub):
+        if self.hook_state is not None:
+            (
+                self.params, self.opt_state, self.hook_state, loss,
+            ) = self.train_step(
+                self.params, self.opt_state, self.hook_state, xs, ys, sub
+            )
+        else:
+            self.params, self.opt_state, loss = self.train_step(
+                self.params, self.opt_state, xs, ys, sub
+            )
+        return loss
 
     def _run_fused(self, pending, avg):
         import jax
@@ -170,9 +184,16 @@ class Trainer:
         ys = np.stack([y for _, y in pending])
         self.rng, sub = _split(self.rng)
         keys = jax.random.split(sub, K)
-        self.params, self.opt_state, losses = self.train_step_k(
-            self.params, self.opt_state, xs, ys, keys
-        )
+        if self.hook_state is not None:
+            (
+                self.params, self.opt_state, self.hook_state, losses,
+            ) = self.train_step_k(
+                self.params, self.opt_state, self.hook_state, xs, ys, keys
+            )
+        else:
+            self.params, self.opt_state, losses = self.train_step_k(
+                self.params, self.opt_state, xs, ys, keys
+            )
         n = sum(x.shape[0] for x, _ in pending)
         avg.update(float(np.asarray(losses).mean()), n)
         return n
@@ -224,6 +245,10 @@ def main():
                    help="fuse K full optimizer steps into one compiled "
                         "program (the headline-bench mode; math identical "
                         "to K sequential steps)")
+    p.add_argument("--quant-hook", action="store_true",
+                   help="all-reduce gradients through the blockwise "
+                   "int8 wire-quantized hook with error feedback "
+                   "(parallel.blockwise_quant_hook)")
     p.add_argument("--cpu", action="store_true",
                    help="force the virtual CPU backend — this box's "
                         "sitecustomize pins the TPU plugin, so the env "
@@ -253,6 +278,12 @@ def main():
     rng = jax.random.PRNGKey(0)
     params = model.init(rng, jnp.zeros((1, 28, 28, 1)))
     ddp = tdx.DistributedDataParallel(model, params)
+    if args.quant_hook:
+        from pytorch_distributed_example_tpu.parallel import (
+            blockwise_quant_hook,
+        )
+
+        ddp.register_comm_hook(None, blockwise_quant_hook(bits=8))
     optimizer = optax.sgd(args.lr, momentum=args.momentum)
 
     trainer = Trainer(ddp, optimizer, train_data, test_data,

@@ -33,6 +33,15 @@ Named injection points wired in this package:
     rendezvous.join                                (rendezvous handlers)
     p2p.connect / p2p.send                         (direct data plane)
     collective.dispatch                            (eager collective path)
+    comm.quantize                                  (before each quantized
+                                                    bucket reduction — the
+                                                    wire-quantized reduce-
+                                                    scatter dispatch in the
+                                                    Reducer's blockwise-quant
+                                                    adapter; fired before any
+                                                    error-feedback commit, so
+                                                    a transient fault + retry
+                                                    replays exactly)
     schedule.mismatch                              (TDX_SCHEDULE_CHECK
                                                     fingerprint; action
                                                     "corrupt" perturbs the
@@ -127,6 +136,7 @@ KNOWN_POINTS = frozenset({
     "p2p.connect",
     "p2p.send",
     "collective.dispatch",
+    "comm.quantize",
     "schedule.mismatch",
     "agent.heartbeat",
     "checkpoint.write",

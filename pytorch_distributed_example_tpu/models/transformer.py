@@ -277,17 +277,30 @@ class Attention(nn.Module):
         write. Attention gathers the row's logical K/V layout and masks
         by absolute position, so dropped/garbage regions are never
         attended (every key <= a live row's position sits in an
-        allocated block — the engine allocates before it writes)."""
+        allocated block — the engine allocates before it writes).
+
+        A QUANTIZED pool (int8 k/v plus `k_scale`/`v_scale` planes —
+        `serve/cache.py::init_paged_cache(quantized=True)`) is detected
+        from the cache collection: writes quantize each token's K/V
+        vector per kv-head (`ops.quant.quantize_kv`) and scatter value
+        and scale through the SAME flat index (same drop semantics);
+        reads dequantize inside `ops.gather_paged_kv`, so the scores/
+        softmax/output math below is identical in both modes."""
         from jax import lax  # noqa: F401 — parity with _decode's imports
 
         from ..ops import gather_paged_kv
+        from ..ops.quant import quantize_kv
 
         cfg = self.cfg
         B, L, KV, Dh = k.shape
         H = cfg.n_heads
         M = cfg.max_seq_len
+        quantized = self.has_variable("cache", "k_scale")
         ck = self.variable("cache", "k", lambda: None)
         cv = self.variable("cache", "v", lambda: None)
+        if quantized:
+            cks = self.variable("cache", "k_scale", lambda: None)
+            cvs = self.variable("cache", "v_scale", lambda: None)
         nblk, bs = ck.value.shape[0], ck.value.shape[1]
         nb = block_tables.shape[1]
 
@@ -312,10 +325,32 @@ class Attention(nn.Module):
             )
             return flat_pool.reshape(nblk, bs, KV, Dh)
 
-        ck.value = scatter(ck.value, k)
-        cv.value = scatter(cv.value, v)
+        def scatter_scale(pool, upd):
+            flat_pool = pool.reshape(nblk * bs, KV)
+            flat_pool = flat_pool.at[flat].set(
+                upd.reshape(B * L, KV), mode="drop"
+            )
+            return flat_pool.reshape(nblk, bs, KV)
 
-        kf, vf = gather_paged_kv(ck.value, cv.value, block_tables)
+        if quantized:
+            # quantize-on-scatter: post-RoPE K and V, one scale per
+            # (token, kv-head); value and scale ride the same flat
+            # index so a dropped write drops both
+            qk, sk = quantize_kv(k)
+            qv, sv = quantize_kv(v)
+            ck.value = scatter(ck.value, qk)
+            cv.value = scatter(cv.value, qv)
+            cks.value = scatter_scale(cks.value, sk)
+            cvs.value = scatter_scale(cvs.value, sv)
+            kf, vf = gather_paged_kv(
+                ck.value, cv.value, block_tables,
+                k_scale=cks.value, v_scale=cvs.value,
+                out_dtype=cfg.dtype,
+            )
+        else:
+            ck.value = scatter(ck.value, k)
+            cv.value = scatter(cv.value, v)
+            kf, vf = gather_paged_kv(ck.value, cv.value, block_tables)
         Mb = nb * bs  # logical key span the tables cover (>= M)
         key_pos = jnp.arange(Mb)
         mask = key_pos[None, None, :] <= pos[:, :, None]  # (B, L, Mb)

@@ -1,4 +1,14 @@
-"""Pallas TPU kernels for the framework's hot ops."""
+"""Pallas TPU kernels for the framework's hot ops — plus the jnp-level
+block-scaled quantization codec (`quant.py`) shared by the quantized
+collectives and the int8 paged KV cache."""
 
+from . import quant  # noqa: F401
 from .flash_attention import flash_attention, gather_paged_kv  # noqa: F401
+from .quant import (  # noqa: F401
+    dequantize_blockwise,
+    dequantize_kv,
+    quantize_blockwise,
+    quantize_kv,
+    quantized_all_reduce,
+)
 from .reference import dense_attention  # noqa: F401

@@ -804,7 +804,10 @@ def flash_attention(
     return _from_bh(o, B, H)
 
 
-def gather_paged_kv(pool_k, pool_v, block_tables):
+def gather_paged_kv(
+    pool_k, pool_v, block_tables, k_scale=None, v_scale=None,
+    out_dtype=None,
+):
     """Materialize each row's LOGICAL K/V layout from a paged block pool.
 
     pool_k/pool_v: (num_blocks, block_size, KV, Dh) — the serve engine's
@@ -819,12 +822,24 @@ def gather_paged_kv(pool_k, pool_v, block_tables):
     replace (today it lowers to an XLA gather feeding the cache-
     attention einsum; the KV-head axis passes through untouched, so a
     TP-sharded pool stays sharded through the gather).
+
+    `k_scale`/`v_scale` ((num_blocks, block_size, KV) f32 — the int8
+    pool's per-(token, kv-head) scale planes) switch on DEQUANT-IN-
+    GATHER: scales ride the same table gather and multiply the int8
+    payload back to `out_dtype` (the attention math dtype), so nothing
+    downstream ever sees quantized values. The scale gather shards the
+    same way on the KV-head axis under TP.
     """
     nblk, bs, KV, Dh = pool_k.shape
     B, nb = block_tables.shape
 
-    def one(pool):
+    def one(pool, scale):
         g = pool[block_tables]  # (B, nb, bs, KV, Dh), OOB ids clamp
+        if scale is not None:
+            s = scale[block_tables]  # (B, nb, bs, KV)
+            g = (g.astype(jnp.float32) * s[..., None]).astype(
+                out_dtype or jnp.float32
+            )
         return g.reshape(B, nb * bs, KV, Dh)
 
-    return one(pool_k), one(pool_v)
+    return one(pool_k, k_scale), one(pool_v, v_scale)

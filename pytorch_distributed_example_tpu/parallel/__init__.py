@@ -2,7 +2,12 @@ from .ddp import DistributedDataParallel, make_ddp_train_step, make_eval_step  #
 from .reducer import Reducer, compute_bucket_assignment_by_size  # noqa: F401
 from .join import Join, Joinable, JoinHook, join_batches  # noqa: F401
 from . import comm_hooks  # noqa: F401
-from .comm_hooks import PowerSGDHook, powerSGD_hook  # noqa: F401
+from .comm_hooks import (  # noqa: F401
+    BlockwiseQuantHook,
+    PowerSGDHook,
+    blockwise_quant_hook,
+    powerSGD_hook,
+)
 from .localsgd import (  # noqa: F401
     HierarchicalModelAverager,
     PeriodicModelAverager,

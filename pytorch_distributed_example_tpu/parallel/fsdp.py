@@ -116,6 +116,8 @@ def _make_constrained_train_step(
     has_rng: bool,
     remat: bool,
     donate: bool,
+    comm_hook: Optional[Callable] = None,
+    hook_axis: Optional[str] = None,
 ):
     """Shared fwd/bwd/update scaffold for the ZeRO family.
 
@@ -123,21 +125,61 @@ def _make_constrained_train_step(
     grads / optimizer state / updated params (and the params' jit
     sharding); everything else — rng threading, remat, donation — lives
     here once.
+
+    `comm_hook` (requires replicated params, i.e. the ZeRO-2 layout and
+    `hook_axis` naming the one data axis): the gradient reduction runs
+    MANUALLY inside a `shard_map` region — per-device grads from the
+    local batch shard, then `hook(grads, axis)` (e.g. the blockwise
+    wire-quantized all-reduce) — instead of falling out of GSPMD, which
+    offers no seam to quantize its implicit reduction. Grads exit the
+    region replicated; the stage's sharding constraints (sharded
+    optimizer update, update all-gather) apply unchanged downstream.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .._compat import shard_map_fn
+
     def step(params, opt_state, x, y, *rng):
-        def objective(p):
+        def objective(p, xl, yl, key):
             if has_rng:
-                fwd = lambda pp: apply_fn(pp, x, rngs={"dropout": rng[0]})
+                fwd = lambda pp: apply_fn(pp, xl, rngs={"dropout": key})
             else:
-                fwd = lambda pp: apply_fn(pp, x)
+                fwd = lambda pp: apply_fn(pp, xl)
             if remat:
                 fwd = jax.checkpoint(fwd)
-            return loss_fn(fwd(p), y)
+            return loss_fn(fwd(p), yl)
 
-        loss, grads = jax.value_and_grad(objective)(params)
+        if comm_hook is None:
+            loss, grads = jax.value_and_grad(
+                lambda p: objective(p, x, y, rng[0] if has_rng else None)
+            )(params)
+        else:
+            from jax import lax
+
+            def local(p, xl, yl):
+                # per-shard dropout key: every device sees its own
+                # batch shard, so the closed-over key must be folded
+                # with the device's axis index — otherwise all W ranks
+                # draw the SAME mask pattern (correlated dropout, and
+                # different semantics from the comm_hook=None path)
+                key = (
+                    jax.random.fold_in(rng[0], lax.axis_index(hook_axis))
+                    if has_rng
+                    else None
+                )
+                loss, g = jax.value_and_grad(
+                    lambda pp: objective(pp, xl, yl, key)
+                )(p)
+                g = comm_hook(g, hook_axis)
+                return lax.pmean(loss, hook_axis), g
+
+            loss, grads = shard_map_fn(
+                local,
+                mesh=jmesh,
+                in_specs=(P(), batch_spec, batch_spec),
+                out_specs=(P(), P()),
+            )(params, x, y)
         grads = constrain_grads(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if constrain_opt_state is not None:
@@ -208,6 +250,7 @@ def make_zero2_train_step(
     has_rng: bool = False,
     remat: bool = False,
     donate: bool = True,
+    comm_hook: Optional[Callable] = None,
 ):
     """ZeRO-2: params REPLICATED, gradients + optimizer state SHARDED.
 
@@ -221,6 +264,20 @@ def make_zero2_train_step(
     DDP's allreduce (reduce-scatter + all-gather), but optimizer math
     and its state are 1/W per device.
 
+    `comm_hook` is the FSDP face of the gradient-compression hooks
+    (`comm_hooks.blockwise_quant_hook(error_feedback=False)` being the
+    wire-quantized one): the grad reduction moves into an explicit
+    shard_map region and runs `hook(grads, axis)` there (GSPMD's
+    implicit reduction has no seam to narrow), cutting the grad-phase
+    wire bytes to the hook's wire width; the update all-gather stays
+    full-precision. STATELESS hooks only — this step's fixed
+    ``(params, opt_state, x, y)`` signature cannot thread a state
+    pytree; error-feedback hooks belong on `make_ddp_train_step`.
+    Requires exactly one of `data_axes` present in the mesh (the hook
+    receives one axis name). ZeRO-3 (`make_fsdp_train_step`) takes no
+    hook: its params are sharded, so they cannot ride a replicated
+    shard_map region without un-sharding them.
+
     Pair with `shard_optimizer_only(opt_state, mesh, axis)` for the
     initial opt-state placement.
     """
@@ -228,6 +285,25 @@ def make_zero2_train_step(
 
     jmesh = getattr(mesh, "jax_mesh", mesh)
     constrain_dim0 = lambda tree: shd.constrain_dim0(tree, jmesh, axis)
+
+    hook_axis = None
+    if comm_hook is not None:
+        if hasattr(comm_hook, "init") and hasattr(comm_hook, "apply"):
+            raise NotImplementedError(
+                "stateful comm hooks (error feedback / PowerSGD) thread "
+                "a state pytree through the step; the ZeRO-2 signature "
+                "cannot — pass a stateless hook (e.g. "
+                "blockwise_quant_hook(error_feedback=False)) or use "
+                "make_ddp_train_step for the stateful form"
+            )
+        present = [a for a in data_axes if a in dict(jmesh.shape)]
+        if len(present) != 1:
+            raise ValueError(
+                f"comm_hook needs exactly one data axis in the mesh; "
+                f"data_axes {tuple(data_axes)} resolve to {present} on "
+                f"mesh axes {tuple(dict(jmesh.shape))}"
+            )
+        hook_axis = present[0]
 
     return _make_constrained_train_step(
         apply_fn,
@@ -245,6 +321,8 @@ def make_zero2_train_step(
         has_rng=has_rng,
         remat=remat,
         donate=donate,
+        comm_hook=comm_hook,
+        hook_axis=hook_axis,
     )
 
 

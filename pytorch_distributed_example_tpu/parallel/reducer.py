@@ -219,10 +219,19 @@ class Reducer:
             # disagreeing on the reduction (or on which hook runs) must
             # diverge even when bucket shapes happen to match
             if self.comm_hook is not None:
+                # hooks that declare `wants_bucket_index` (the blockwise
+                # quant adapter's error-feedback keying) get the bucket
+                # number; the legacy (backend, flat) contract is unchanged
+                if getattr(self.comm_hook, "wants_bucket_index", False):
+                    run = lambda flat=flat, bno=bucket_no: self.comm_hook(
+                        backend, flat, bno
+                    )
+                else:
+                    run = lambda flat=flat: self.comm_hook(backend, flat)
                 out, work = self.group._dispatch(
                     f"reduce_bucket[{bucket_no}]",
                     flat,
-                    lambda flat=flat: self.comm_hook(backend, flat),
+                    run,
                     detail=getattr(self.comm_hook, "__name__", "comm_hook"),
                 )
             else:
@@ -242,6 +251,12 @@ class Reducer:
             b.pending_work.wait()
             for i, off, ln, shp in zip(b.leaf_indices, b.offsets, b.lengths, b.shapes):
                 new_leaves[i] = b.flat[:, off : off + ln].reshape((W,) + shp)
+        # stateful hooks stage per-bucket state and commit only on a
+        # fully-successful pass (the blockwise-quant adapter's error
+        # feedback): a fault at ANY bucket leaves the carry untouched,
+        # so a whole-pass retry replays exactly
+        if hasattr(self.comm_hook, "on_reduce_complete"):
+            self.comm_hook.on_reduce_complete()
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def _fused_prog(self, idx_list, leaves):

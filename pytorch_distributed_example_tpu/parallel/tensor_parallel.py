@@ -205,11 +205,17 @@ def shard_kv_pool(tree, mesh, axis: str = "tp"):
     size = dict(jmesh.shape)[axis]
 
     def leaf(x):
-        spec = (
-            P(None, None, axis, None)
-            if getattr(x, "ndim", 0) == 4 and x.shape[2] % size == 0
-            else P()
-        )
+        # 4-d (nblk, bs, KV, Dh) K/V pools and 3-d (nblk, bs, KV) scale
+        # planes (the int8 pool's per-token scales) both shard on their
+        # KV-head axis — the dequant-in-gather multiply then partitions
+        # alongside the payload gather with no resharding
+        ndim = getattr(x, "ndim", 0)
+        if ndim == 4 and x.shape[2] % size == 0:
+            spec = P(None, None, axis, None)
+        elif ndim == 3 and x.shape[2] % size == 0:
+            spec = P(None, None, axis)
+        else:
+            spec = P()
         return jax.device_put(x, NamedSharding(jmesh, spec))
 
     return jax.tree_util.tree_map(leaf, tree)

@@ -14,7 +14,11 @@ Two layouts live here:
   into out-of-bounds scatter drops, which is how parked lanes and
   padded chunks stay harmless. The pool is exhaustible by design: a
   failed `ensure_blocks` is the engine's backpressure/preemption
-  signal.
+  signal. `quantized=True` stores K/V as INT8 with per-(token,
+  kv-head) f32 scales (`ops/quant.py`) — ~(4 / (1 + 4/head_dim))x
+  more blocks at fixed pool bytes, quantize-on-scatter in the paged
+  write, dequant inside `ops.gather_paged_kv` so attention math stays
+  full precision.
 
 * `SlotKVCache` — the PR 4 dense per-slot layout, kept as the
   reference/baseline the bench and the parity tests compare against:
@@ -142,17 +146,44 @@ class SlotKVCache:
         )
 
 
-def init_paged_cache(model, num_blocks: int, block_size: int):
+def init_paged_cache(model, num_blocks: int, block_size: int,
+                     quantized: bool = False):
     """Empty paged K/V pool tree for `model`: per layer one
     (num_blocks, block_size, kv_heads, head_dim) K and V. Mirrors
     `models.generate.init_cache`'s structure minus the scalar "index"
-    leaf (a shared pool has no per-row cursor)."""
+    leaf (a shared pool has no per-row cursor).
+
+    `quantized=True` switches the pool to INT8 K/V plus per-(block
+    slot, kv-head) f32 scale planes `k_scale`/`v_scale` of shape
+    (num_blocks, block_size, kv_heads) — one max-abs scale per stored
+    token vector (`ops/quant.py::quantize_kv`), the granularity that
+    lets quantize-on-scatter land a token in a shared block without
+    requantizing the block's earlier tokens. The paged attention path
+    detects the scale planes and dequantizes inside
+    `ops.gather_paged_kv`, so the attention math stays cfg.dtype."""
     import jax.numpy as jnp
 
     cfg = model.cfg
     KV, Dh = cfg.kv_heads, cfg.head_dim
 
     def one_layer():
+        if quantized:
+            return {
+                "attn": {
+                    "k": jnp.zeros(
+                        (num_blocks, block_size, KV, Dh), jnp.int8
+                    ),
+                    "v": jnp.zeros(
+                        (num_blocks, block_size, KV, Dh), jnp.int8
+                    ),
+                    "k_scale": jnp.zeros(
+                        (num_blocks, block_size, KV), jnp.float32
+                    ),
+                    "v_scale": jnp.zeros(
+                        (num_blocks, block_size, KV), jnp.float32
+                    ),
+                }
+            }
         return {
             "attn": {
                 "k": jnp.zeros((num_blocks, block_size, KV, Dh), cfg.dtype),
@@ -182,6 +213,7 @@ class PagedKVCache:
         slots: int,
         num_blocks: Optional[int] = None,
         block_size: int = 16,
+        quantized: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -192,6 +224,7 @@ class PagedKVCache:
         self.model = model
         self.slots = slots
         self.block_size = block_size
+        self.quantized = quantized
         self.blocks_per_seq = -(-M // block_size)  # nb: ceil(M / bs)
         if num_blocks is None:
             # dense-equivalent capacity: every slot can hold max_seq_len.
@@ -205,7 +238,9 @@ class PagedKVCache:
             )
         self.num_blocks = num_blocks
         self.invalid_block = num_blocks  # OOB sentinel the paged path drops
-        self.tree = init_paged_cache(model, num_blocks, block_size)
+        self.tree = init_paged_cache(
+            model, num_blocks, block_size, quantized=quantized
+        )
         self.block_tables = np.full(
             (slots, self.blocks_per_seq), self.invalid_block, np.int32
         )
@@ -299,13 +334,41 @@ class PagedKVCache:
 
     @functools.cached_property
     def bytes_per_block(self) -> int:
-        """HBM bytes one block pins across every layer (K + V)."""
+        """HBM bytes one block pins across every layer (K + V, PLUS the
+        per-token scale planes when quantized — the true pool cost, so
+        fixed-pool-bytes comparisons account the scale overhead)."""
         cfg = self.model.cfg
-        itemsize = np.dtype(cfg.dtype).itemsize
+        itemsize = (
+            1 if self.quantized else np.dtype(cfg.dtype).itemsize
+        )
         return (
             2 * cfg.n_layers * self.block_size * cfg.kv_heads
             * cfg.head_dim * itemsize
-        )
+        ) + self.scale_bytes_per_block
+
+    @functools.cached_property
+    def scale_bytes_per_block(self) -> int:
+        """Scale-plane bytes one block pins (0 unquantized): one f32 per
+        (token slot, kv-head) for K and V across every layer."""
+        if not self.quantized:
+            return 0
+        cfg = self.model.cfg
+        return 2 * cfg.n_layers * self.block_size * cfg.kv_heads * 4
+
+    @property
+    def wire_dtype(self) -> str:
+        """The pool's storage dtype name — the cache analog of the
+        gradient hooks' wire format."""
+        if self.quantized:
+            return "int8"
+        return str(np.dtype(self.model.cfg.dtype).name)
+
+    @property
+    def effective_slots(self) -> int:
+        """How many WORST-CASE (max_seq_len) requests the pool can hold
+        concurrently — the servable-slots-per-chip capacity figure the
+        int8 pool roughly doubles at fixed pool bytes."""
+        return self.num_blocks // self.blocks_per_seq
 
     @property
     def bytes_live(self) -> int:
@@ -330,5 +393,6 @@ class PagedKVCache:
             f"PagedKVCache(slots={self.slots}, "
             f"blocks={self.live_blocks}/{self.num_blocks}, "
             f"block_size={self.block_size}, "
-            f"active={int(self._in_use.sum())})"
+            f"active={int(self._in_use.sum())}, "
+            f"wire={self.wire_dtype})"
         )

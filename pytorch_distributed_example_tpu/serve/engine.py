@@ -23,6 +23,15 @@ deadlock-free: the oldest request can always claim enough blocks to
 finish. Admission additionally waits until the pool can hold a
 request's first chunk, so nothing thrashes at the door.
 
+``kv_quant=True`` switches the pool to the INT8 cache
+(`serve/cache.py` quantized mode): ~4x the blocks per pool byte (minus
+the per-(token, kv-head) scale overhead), quantize-on-scatter in the
+paged write, dequant-in-gather so decode math is unchanged — at fixed
+pool bytes this roughly doubles the concurrently servable requests
+(the `serve_bench.py --trace capacity` row). Scheduling, preemption,
+and replay are dtype-blind: a preempted quantized request replays
+token-identically because quantization is deterministic.
+
 Tensor-parallel decode: pass ``mesh=`` (a `DeviceMesh`/`jax.sharding.
 Mesh` with a ``tp`` axis) and the engine places params per
 `models.transformer.sharding_rules`, the block pool KV-head-sharded
@@ -97,6 +106,8 @@ class ServeEngine:
         max_queue_depth: Optional[int] = None,
         mesh=None,
         tp_axis: str = "tp",
+        kv_quant: bool = False,
+        conservative_admission: bool = False,
     ):
         self.model = model
         self.params = params["params"] if "params" in params else params
@@ -106,7 +117,8 @@ class ServeEngine:
         self.eos_id = eos_id
         self.clock = clock
         self.cache = PagedKVCache(
-            model, slots, num_blocks=pool_blocks, block_size=block_size
+            model, slots, num_blocks=pool_blocks, block_size=block_size,
+            quantized=kv_quant,
         )
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self.metrics = metrics or ServeMetrics(clock=clock, slots=slots)
@@ -118,6 +130,15 @@ class ServeEngine:
                 f"{prefill_chunk_tokens}"
             )
         self.prefill_chunk_tokens = prefill_chunk_tokens
+        # conservative admission: reserve every request's WORST-CASE
+        # block footprint at admission, so admitted work can always grow
+        # to completion and pool-pressure preemption never fires —
+        # trades pool utilization for churn-free scheduling (and makes
+        # "concurrently admitted requests" a direct measure of pool
+        # capacity, the serve_bench capacity row). `_reserved` tracks
+        # the active set's worst-case total.
+        self.conservative_admission = conservative_admission
+        self._reserved = 0
         self.mesh = mesh
         (
             self._prefill_chunk,
@@ -236,14 +257,21 @@ class ServeEngine:
         while True:
             if not self.queue:
                 return admitted
-            head_len = self.queue.peek_len()
-            if head_len is None:
+            head = self.queue.peek()
+            if head is None:
                 return admitted
+            head_len = len(head.prompt)
             need = self.cache.blocks_for(
                 min(self._chunk_len(head_len), head_len)
             )
             if need > self.cache.free_blocks:
                 return admitted  # pool backpressure: wait for retires
+            if self.conservative_admission:
+                worst = self.cache.blocks_for(
+                    head_len + head.max_new_tokens
+                )
+                if self._reserved + worst > self.cache.num_blocks:
+                    return admitted  # worst-case reservation gate
             slot = self.cache.allocate()
             if slot is None:
                 return admitted
@@ -265,8 +293,14 @@ class ServeEngine:
             self._slot_req[slot] = req
             self._slot_tokens[slot] = []
             self._prefilling[slot] = _Prefill(req)
+            self._reserved += self._worst_blocks(req)
             self.metrics.record_admit()
             admitted += 1
+
+    def _worst_blocks(self, req: Request) -> int:
+        """A request's worst-case block footprint (prompt + full token
+        budget) — the conservative-admission reservation unit."""
+        return self.cache.blocks_for(len(req.prompt) + req.max_new_tokens)
 
     # -- chunked prefill ---------------------------------------------------
     def _prefill_tick(self) -> None:
@@ -406,6 +440,7 @@ class ServeEngine:
         self._decoding.discard(slot)
         self.queue.requeue_front(req)
         self.cache.free(slot)
+        self._reserved -= self._worst_blocks(req)
         if requeue_counter:
             self.metrics.record_requeue()
 
@@ -425,6 +460,9 @@ class ServeEngine:
             self.cache.bytes_per_block,
             len(self._decoding) + len(self._prefilling),
             self.cache.dense_bytes_per_request,
+            wire_dtype=self.cache.wire_dtype,
+            scale_bytes_per_block=self.cache.scale_bytes_per_block,
+            effective_slots=self.cache.effective_slots,
         )
         while True:
             self._prefill_tick()
@@ -530,6 +568,7 @@ class ServeEngine:
         self._slot_tokens[slot] = []
         self._decoding.discard(slot)
         self.cache.free(slot)  # slot AND its blocks return to the pool
+        self._reserved -= self._worst_blocks(req)
 
     def requeue_inflight(self) -> int:
         """Drain every in-flight request (decoding AND mid-prefill) back
@@ -555,6 +594,7 @@ class ServeEngine:
             self._decoding.discard(s)
             self.queue.requeue_front(req)
             self.cache.free(s)
+            self._reserved -= self._worst_blocks(req)
         self.metrics.record_requeue(len(inflight))
         return len(inflight)
 

@@ -59,6 +59,10 @@ class ServeMetrics:
         self.pool_blocks_total = 0
         self.pool_bytes_per_block = 0
         self.dense_bytes_per_request = 0
+        self.cache_wire_dtype = ""  # pool storage dtype (int8 when quantized)
+        self.scale_bytes_per_block = 0  # quantized pools: scale-plane bytes
+        self.effective_slots = 0  # worst-case requests the pool can hold
+        self.peak_slots_active = 0  # max concurrent in-flight requests seen
         self._pool_util_sum = 0.0
         self._pool_samples = 0
         self._bytes_per_req_sum = 0.0
@@ -91,6 +95,7 @@ class ServeMetrics:
             self.steps += 1
             self.queue_depth = queue_depth
             self.slots_active = slots_active
+            self.peak_slots_active = max(self.peak_slots_active, slots_active)
             if self.slots:
                 self._occupancy_steps += slots_active / self.slots
 
@@ -115,16 +120,25 @@ class ServeMetrics:
         bytes_per_block: int,
         live_requests: int,
         dense_bytes_per_request: int,
+        wire_dtype: str = "",
+        scale_bytes_per_block: int = 0,
+        effective_slots: int = 0,
     ) -> None:
         """Per-step paged-pool observation. Gauges keep the LAST value;
         utilization and bytes-per-live-request also accumulate a
         time-mean (bytes/request samples only when requests are live,
-        so idle steps don't dilute the memory claim)."""
+        so idle steps don't dilute the memory claim). `wire_dtype` /
+        `scale_bytes_per_block` / `effective_slots` describe the pool's
+        storage format (int8 pools report their scale-plane overhead
+        and the capacity-in-worst-case-requests figure)."""
         with self._lock:
             self.pool_blocks_live = blocks_live
             self.pool_blocks_total = blocks_total
             self.pool_bytes_per_block = bytes_per_block
             self.dense_bytes_per_request = dense_bytes_per_request
+            self.cache_wire_dtype = wire_dtype
+            self.scale_bytes_per_block = scale_bytes_per_block
+            self.effective_slots = effective_slots
             if blocks_total:
                 self._pool_util_sum += blocks_live / blocks_total
                 self._pool_samples += 1
@@ -205,6 +219,7 @@ class ServeMetrics:
                 "queue_depth": self.queue_depth,
                 "slots": self.slots,
                 "slots_active": self.slots_active,
+                "peak_slots_active": self.peak_slots_active,
                 "mean_occupancy": round(occupancy, 4),
                 "tokens_completed": self.tokens_completed,
                 "latency": lat,
@@ -223,6 +238,14 @@ class ServeMetrics:
                     "dense_reduction_x": round(
                         self.dense_bytes_per_request / mean_bpr, 2
                     ) if mean_bpr else 0.0,
+                    # storage format: int8 pools report their wire dtype,
+                    # the scale-plane overhead, and how many worst-case
+                    # requests the pool holds (slots-per-chip capacity)
+                    "wire_dtype": self.cache_wire_dtype,
+                    "scale_overhead_bytes": (
+                        self.scale_bytes_per_block * self.pool_blocks_total
+                    ),
+                    "effective_slots": self.effective_slots,
                 },
             }
         snap["goodput_tokens_per_sec"] = round(

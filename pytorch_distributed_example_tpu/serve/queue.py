@@ -99,12 +99,12 @@ class RequestQueue:
         with self._lock:
             return self._q.popleft() if self._q else None
 
-    def peek_len(self) -> Optional[int]:
-        """Prompt length of the HEAD request (None when empty) — the
-        engine's admission gate sizes the first prefill chunk from it
-        without popping."""
+    def peek(self) -> Optional[Request]:
+        """The HEAD request without popping (None when empty) — the
+        engine's admission gate sizes the first prefill chunk from it,
+        and the conservative gate also needs its token budget."""
         with self._lock:
-            return len(self._q[0].prompt) if self._q else None
+            return self._q[0] if self._q else None
 
     @property
     def depth(self) -> int:

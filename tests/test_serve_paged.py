@@ -520,6 +520,221 @@ class TestTensorParallelDecode:
         q = eng.params["layers_0"]["attn"]["q_proj"]["kernel"]
         assert "tp" in (q.sharding.spec[-1] or ())
 
+_TRAINED_CACHE = {}
+
+
+def _trained_model(max_seq_len=48, steps=150):
+    """Tiny LM briefly pretrained on the deterministic bigram chain via
+    the shared `benchmarks.common.chain_pretrain` recipe (see its
+    docstring: greedy decode on random-init weights argmaxes over
+    near-tied logits, so a match-rate test there measures argmax noise,
+    not cache fidelity — trained margins make token flips attributable
+    to quantization)."""
+    from benchmarks.common import chain_pretrain
+
+    if (max_seq_len, steps) in _TRAINED_CACHE:
+        return _TRAINED_CACHE[(max_seq_len, steps)]
+    model, params = _model(max_seq_len=max_seq_len)
+    params, chain, _ = chain_pretrain(
+        model, params, train_len=max_seq_len, steps=steps, seed=7
+    )
+    _TRAINED_CACHE[(max_seq_len, steps)] = (model, params, chain)
+    return model, params, chain
+
+
+class TestQuantizedKV:
+    def test_quantized_pool_layout_and_capacity(self):
+        """int8 pool: K/V int8 + per-(token, kv-head) f32 scale planes;
+        bytes accounting includes the scale overhead; at FIXED pool
+        bytes the int8 pool holds >= 1.8x the worst-case requests."""
+        import jax.numpy as jnp
+
+        from pytorch_distributed_example_tpu.serve import PagedKVCache
+
+        model, _ = _model()
+        cfg = model.cfg
+        f = PagedKVCache(model, slots=2, num_blocks=8, block_size=4)
+        q = PagedKVCache(
+            model, slots=2, num_blocks=8, block_size=4, quantized=True
+        )
+        layer = q.tree["layers_0"]["attn"]
+        assert layer["k"].dtype == jnp.int8 and layer["v"].dtype == jnp.int8
+        assert layer["k_scale"].dtype == jnp.float32
+        assert layer["k_scale"].shape == (8, 4, cfg.kv_heads)
+        scale_b = 2 * cfg.n_layers * 4 * cfg.kv_heads * 4
+        payload_b = 2 * cfg.n_layers * 4 * cfg.kv_heads * cfg.head_dim
+        assert q.scale_bytes_per_block == scale_b
+        assert q.bytes_per_block == payload_b + scale_b
+        assert f.scale_bytes_per_block == 0
+        assert q.wire_dtype == "int8" and f.wire_dtype == "float32"
+        # fixed-byte capacity: same pool bytes -> >= 1.8x the blocks,
+        # and effective (worst-case-request) slots scale with them
+        blocks_q = (f.num_blocks * f.bytes_per_block) // q.bytes_per_block
+        assert blocks_q / f.num_blocks >= 1.8
+        big = PagedKVCache(
+            model, slots=2, num_blocks=int(blocks_q), block_size=4,
+            quantized=True,
+        )
+        assert big.effective_slots >= int(1.8 * f.effective_slots)
+
+    def test_quantized_greedy_match_rate_vs_f32(self, no_fault_plan):
+        """ACCEPTANCE: on a trained model, int8-KV greedy decode matches
+        the f32 cache's token stream at >= 0.99 per-token rate."""
+        from pytorch_distributed_example_tpu.serve import ServeEngine
+
+        model, params, chain = _trained_model()
+        gen = np.random.default_rng(3)
+        prompts = [
+            chain(int(gen.integers(0, 64)), int(n))
+            for n in gen.integers(6, 16, 8)
+        ]
+        budgets = [int(b) for b in gen.integers(8, 24, 8)]
+
+        def run(kv_quant):
+            eng = ServeEngine(
+                model, params, slots=4, min_bucket=4,
+                prefill_chunk_tokens=4, kv_quant=kv_quant,
+            )
+            rids = [
+                eng.submit(p, m) for p, m in zip(prompts, budgets)
+            ]
+            out = eng.run(max_steps=2000)
+            assert eng.metrics.completed == len(prompts)
+            return [out[r].tokens for r in rids]
+
+        ref, got = run(False), run(True)
+        matched = sum(
+            int(a == b) for ra, rb in zip(ref, got) for a, b in zip(ra, rb)
+        )
+        total = sum(len(r) for r in ref)
+        assert matched / total >= 0.99, f"match rate {matched / total:.4f}"
+
+    def test_quantized_preemption_replays_identically(self, no_fault_plan):
+        """Preempted int8-KV requests replay token-identically: the
+        per-token scales make quantize-on-scatter deterministic and
+        independent of write batching, so a from-seed replay (and a run
+        with no pool pressure at all) lands the same stream."""
+        from pytorch_distributed_example_tpu.serve import ServeEngine
+
+        model, params, chain = _trained_model()
+        prompts = [chain(s, n) for s, n in [(3, 8), (11, 9), (23, 7), (41, 10)]]
+        budgets = [12, 11, 13, 10]
+
+        def run(pool_blocks, slots=3):
+            eng = ServeEngine(
+                model, params, slots=slots, min_bucket=4, block_size=4,
+                pool_blocks=pool_blocks, prefill_chunk_tokens=3,
+                kv_quant=True,
+            )
+            rids = [eng.submit(p, m) for p, m in zip(prompts, budgets)]
+            out = eng.run(max_steps=2000)
+            assert eng.metrics.completed == len(prompts)
+            assert eng.cache.live_blocks == 0
+            return eng, [out[r].tokens for r in rids]
+
+        # 12 blocks x 4 = one max-seq worst case (the submit() floor);
+        # three ~5-block requests contend -> youngest-first preemption
+        tight_eng, tight = run(12)
+        assert tight_eng.metrics.preempted > 0
+        _, tight2 = run(12)
+        ample_eng, ample = run(64)  # no pressure at all
+        assert ample_eng.metrics.preempted == 0
+        assert tight == tight2  # deterministic under preemption
+        assert tight == ample  # and identical to the pressure-free run
+
+    def test_quantized_chaos_prefill_fault_replay(self, no_fault_plan):
+        """The serve.prefill_chunk chaos contract holds quantized: a
+        transient fault requeues and the replay is token-identical."""
+        from pytorch_distributed_example_tpu.serve import ServeEngine
+
+        model, params, chain = _trained_model()
+        prompts = [chain(s, n) for s, n in [(5, 9), (17, 7), (29, 5)]]
+        budgets = [5, 6, 4]
+
+        def run(plan):
+            if plan:
+                faults.install_plan(plan, export_env=False)
+            eng = ServeEngine(
+                model, params, slots=2, min_bucket=4,
+                prefill_chunk_tokens=3, kv_quant=True,
+            )
+            rids = [eng.submit(p, m) for p, m in zip(prompts, budgets)]
+            out = eng.run(max_steps=800)
+            faults.clear_plan()
+            assert eng.metrics.completed == len(prompts)
+            return eng, [out[r].tokens for r in rids]
+
+        _, want = run(None)
+        eng, got = run(
+            [{"point": "serve.prefill_chunk", "action": "reset", "after": 2}]
+        )
+        assert eng.metrics.requeued >= 1
+        assert got == want
+
+    def test_quantized_tp2_matches_single_device(self, no_fault_plan):
+        """TP2 decode over the KV-head-sharded int8 pool (scale planes
+        sharded alongside) produces the same tokens as the single-device
+        quantized engine, chunked prefill on."""
+        from pytorch_distributed_example_tpu.serve import ServeEngine
+
+        model, params, chain = _trained_model()
+        mesh = _tp_mesh(2)
+        prompts = [chain(s, n) for s, n in [(2, 6), (9, 8), (31, 5)]]
+        budgets = [6, 5, 7]
+
+        def run(mesh_):
+            eng = ServeEngine(
+                model, params, slots=2, min_bucket=4, mesh=mesh_,
+                prefill_chunk_tokens=4, kv_quant=True,
+            )
+            rids = [eng.submit(p, m) for p, m in zip(prompts, budgets)]
+            out = eng.run(max_steps=800)
+            assert eng.metrics.completed == len(prompts)
+            return eng, [out[r].tokens for r in rids]
+
+        _, single = run(None)
+        eng, tp = run(mesh)
+        assert tp == single
+        # after a run the cache leaves are jit outputs, whose inferred
+        # specs may drop trailing Nones — pin the KV-head axis entry
+        layer = eng.cache.tree["layers_0"]["attn"]
+        assert tuple(layer["k"].sharding.spec)[:3] == (None, None, "tp")
+        assert tuple(layer["k_scale"].sharding.spec)[:3] == (
+            None, None, "tp",
+        )
+
+    def test_serve_route_reports_wire_format(self, no_fault_plan):
+        """SATELLITE: /serve exposes the cache wire dtype, the scale
+        overhead bytes, and effective slots-per-chip."""
+        import json
+        import urllib.request
+
+        from pytorch_distributed_example_tpu.serve import ServeEngine
+        from pytorch_distributed_example_tpu.utils.debug_http import (
+            DebugServer,
+        )
+
+        model, params = _model()
+        (prompt,) = _prompts(4)
+        eng = ServeEngine(
+            model, params, slots=1, min_bucket=4, kv_quant=True
+        )
+        eng.submit(prompt, 3)
+        eng.run(max_steps=100)
+        srv = DebugServer()
+        try:
+            srv.register_serve_metrics("engine", eng.metrics)
+            with urllib.request.urlopen(srv.url + "/serve") as r:
+                doc = json.loads(r.read())
+            pool = doc["engine"]["cache_pool"]
+            assert pool["wire_dtype"] == "int8"
+            assert pool["scale_overhead_bytes"] > 0
+            assert pool["effective_slots"] == eng.cache.effective_slots
+        finally:
+            srv.shutdown()
+
+
+class TestTensorParallelDecodeWide:
     @pytest.mark.slow
     def test_tp4_multichip_trace(self, no_fault_plan):
         """Wider-mesh serving smoke (slow tier): a mixed trace with
