@@ -28,12 +28,22 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # (it force-registers the TPU plugin), so the pin must run as code
 # before the first backend touch — same recipe as conftest.py.
 _CPU_PIN = (
-    "import os, sys, runpy, jax;"
-    "jax.config.update('jax_platforms','cpu');"
-    "jax.config.update('jax_num_cpu_devices',"
-    " int(os.environ.get('TDX_CPU_DEVICES','8')));"
-    "sys.argv = sys.argv[1:];"
-    "runpy.run_path(sys.argv[0], run_name='__main__')"
+    "import os, sys, runpy, jax\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "n = os.environ.get('TDX_CPU_DEVICES', '8')\n"
+    "try:\n"
+    "    jax.config.update('jax_num_cpu_devices', int(n))\n"
+    "except AttributeError:\n"
+    "    # older jax has no jax_num_cpu_devices: the XLA flag works as\n"
+    "    # long as it lands before the first backend touch (it does —\n"
+    "    # importing jax does not initialize backends)\n"
+    "    flags = os.environ.get('XLA_FLAGS', '')\n"
+    "    if 'xla_force_host_platform_device_count' not in flags:\n"
+    "        os.environ['XLA_FLAGS'] = (\n"
+    "            flags + ' --xla_force_host_platform_device_count=' + n\n"
+    "        )\n"
+    "sys.argv = sys.argv[1:]\n"
+    "runpy.run_path(sys.argv[0], run_name='__main__')\n"
 )
 
 
@@ -171,6 +181,34 @@ def _jobs(quick: bool):
              "capacity"]
             + (
                 ["--preset", "tiny", "--requests", "16"]
+                if q
+                else ["--preset", "small", "--requests", "32"]
+            ),
+            {},
+        ),
+        (
+            # multi-tenant SLO protection under overload (ISSUE 8): gold
+            # p99 TTFT <= 1.2x its uncontended value while bronze absorbs
+            # explicit sheds, vs FIFO collapse in the baseline
+            "serve_multitenant",
+            [sys.executable, "benchmarks/serve_bench.py", "--trace",
+             "multitenant"]
+            + (
+                ["--preset", "tiny", "--requests", "24", "--slots", "4"]
+                if q
+                else ["--preset", "small", "--requests", "48"]
+            ),
+            {},
+        ),
+        (
+            # kill-mid-traffic recovery (ISSUE 8): checkpoint-every-step
+            # + abandon + restore; recovery_time_s row, token identity
+            # asserted inside the bench
+            "serve_recovery",
+            [sys.executable, "benchmarks/serve_bench.py", "--trace",
+             "recovery"]
+            + (
+                ["--preset", "tiny", "--requests", "12", "--slots", "4"]
                 if q
                 else ["--preset", "small", "--requests", "32"]
             ),

@@ -43,3 +43,29 @@ def shard_map_fn(f, mesh, in_specs, out_specs):
     sm, kw = _resolve_shard_map()
     kwargs = {kw: False} if kw else {}
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def axis_size(axis_name):
+    """`lax.axis_size` across jax versions.
+
+    Older jax has no `lax.axis_size`; `lax.psum(1, axis_name)` is the
+    classic equivalent and constant-folds to a Python int for static
+    operands, so shape math downstream stays static either way.
+    """
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas-TPU compiler params across the
+    `TPUCompilerParams` -> `CompilerParams` rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -94,6 +94,13 @@ class WorkerSpec:
     store_failover: bool = True  # node-elastic only
     advertise_addr: Optional[str] = None  # this agent's dialable host
     failover_grace_s: Optional[float] = None  # default 2x heartbeat timeout
+    # Serve-aware drain (ROADMAP item 5): before tearing a gang down for
+    # a restart/resize, publish the generation-scoped drain key
+    # (`serve/drain/gen{g}`) on the store and give serve loops up to
+    # this long to drain at a step boundary and checkpoint their queue +
+    # in-flight request state (serve/elastic.py) before SIGTERM. 0 (the
+    # default) keeps the PR 1 teardown behavior: no signal, no wait.
+    serve_drain_grace_s: float = 0.0
     env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -170,6 +177,13 @@ class RunResult:
 _JOIN_KEY = "agent/join_waiting"  # NOT generation-namespaced: must survive re-forms
 _FATAL_KEY = "agent/fatal"
 
+# Agent -> serve-loop drain contract: the agent sets
+# f"{SERVE_DRAIN_PREFIX}/gen{g}" before a restart/resize teardown;
+# serve workers poll it between steps (serve/elastic.py imports this
+# constant — the agent side stays jax-free, so the dependency points
+# THIS way).
+SERVE_DRAIN_PREFIX = "serve/drain"
+
 
 def _mark_fatal(ctrl) -> None:
     """Poison-pill the whole supervision tree: every agent polls
@@ -242,6 +256,7 @@ class LocalElasticAgent:
         self._store_host_node = 0  # owner of the ACTIVE store endpoint
         self._advertise = self._compute_advertise()
         self.failovers = 0
+        self._prev_world: Optional[int] = None  # agent.resize detector
 
     # -- store hosting -----------------------------------------------------
     def _ensure_store(self) -> Optional[TCPStore]:
@@ -355,6 +370,19 @@ class LocalElasticAgent:
         else:
             world = nproc if self.spec.elastic else self.spec.world_size
             grank = self.spec.node_rank
+        if self._prev_world is not None and world != self._prev_world:
+            # "agent.resize" fault point: the gang is about to respawn at
+            # a CHANGED world size (elastic shrink/grow, node join/loss).
+            # Chaos plans target the resize boundary itself — e.g. crash
+            # the agent mid-resize, or delay to widen the recovery window.
+            faults.fire(
+                "agent.resize",
+                rank=self.spec.node_rank,
+                old_world=self._prev_world,
+                new_world=world,
+                gen=self.restart_count,
+            )
+        self._prev_world = world
         for r in range(nproc):
             global_rank = grank * nproc + r
             env = {
@@ -403,6 +431,39 @@ class LocalElasticAgent:
                 stderr = subprocess.STDOUT
             proc = subprocess.Popen(argv, env=env, stdout=stdout, stderr=stderr)
             self._workers.append(_Worker(r, proc, WorkerState.HEALTHY))
+
+    def _signal_drain(self) -> None:
+        """Serve-aware teardown: publish the generation-scoped drain key
+        and wait (up to `serve_drain_grace_s`) for serve loops to
+        checkpoint and exit on their own. Workers that are not serve
+        loops, or that ignore the signal, just get the normal SIGTERM
+        when the grace lapses — this only ever DELAYS the teardown, it
+        cannot block it."""
+        grace = self.spec.serve_drain_grace_s
+        if grace <= 0:
+            return
+        if not any(
+            w.proc is not None and w.proc.poll() is None
+            for w in self._workers
+        ):
+            return  # nothing left alive to drain
+        store = self._ctrl if self._ctrl is not None else self._store
+        if store is None:
+            return
+        try:
+            store.set(
+                f"{SERVE_DRAIN_PREFIX}/gen{self.restart_count}", b"1"
+            )
+        except Exception:
+            return  # store gone: nowhere to checkpoint anyway
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if all(
+                w.proc is None or w.proc.poll() is not None
+                for w in self._workers
+            ):
+                return  # every worker drained and exited early
+            time.sleep(min(self.spec.monitor_interval_s, 0.05))
 
     def _stop_workers(self) -> None:
         for w in self._workers:
@@ -1119,8 +1180,11 @@ class LocalElasticAgent:
                 # "restart": rejoin the gang for the next generation
             # bracket the (potentially slow) teardown with heartbeats so
             # a SIGTERM-ignoring worker's kill wait cannot make THIS node
-            # look dead to its peers
+            # look dead to its peers. Serve drains ride inside the
+            # bracket — keep serve_drain_grace_s below heartbeat_timeout_s
+            # on node-elastic gangs or the drain wait reads as node loss.
             self._heartbeat(ctrl)
+            self._signal_drain()
             self._stop_workers()
             self._heartbeat(ctrl)
             if self._peek(ctrl, _FATAL_KEY) is not None:
@@ -1171,13 +1235,17 @@ class LocalElasticAgent:
                     # generation boundary for a join: healthy workers are
                     # re-rendezvoused at the grown size (torchelastic
                     # restarts the worker group when a node joins)
+                    self._signal_drain()
                     self._stop_workers()
                     self.active_nproc = self._admit_joiners(self.active_nproc)
                     self.restart_count += 1
                     self._start_workers()
                     continue
-                # failure: tear down the whole gang and re-rendezvous
+                # failure: tear down the whole gang and re-rendezvous —
+                # surviving serve loops get the drain grace to checkpoint
+                # their queue state before SIGTERM
                 n_failed = getattr(self, "_observed_failed", 1)
+                self._signal_drain()
                 self._stop_workers()
                 if self.spec.elastic:
                     if self._failure_restarts >= self.spec.max_restarts:

@@ -61,6 +61,19 @@ Named injection points wired in this package:
                                                     requeues the half-prefilled
                                                     request, frees its blocks,
                                                     and it replays from seed)
+    serve.drain                                    (before an elastic drain
+                                                    snapshot is cut — fired
+                                                    with the engine untouched,
+                                                    so a transient fault
+                                                    aborts the drain cleanly)
+    serve.restore                                  (before a serve-state
+                                                    checkpoint is read back on
+                                                    the re-formed gang)
+    agent.resize                                   (elastic agent, before
+                                                    respawning a gang at a
+                                                    CHANGED world size —
+                                                    shrink, grow, or node-
+                                                    membership change)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -144,6 +157,9 @@ KNOWN_POINTS = frozenset({
     "serve.admit",
     "serve.prefill_chunk",
     "serve.step",
+    "serve.drain",
+    "serve.restore",
+    "agent.resize",
     "train.step",
 })
 

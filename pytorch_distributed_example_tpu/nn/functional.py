@@ -30,6 +30,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .._compat import axis_size as _axis_size
+
 from ..types import ReduceOp
 
 
@@ -130,7 +132,7 @@ def scatter(x, src: int = 0, axis_name: str = "dp", axis: int = 0):
     from jax import lax
 
     full = broadcast(x, src, axis_name)  # replicate src's full tensor
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     if full.shape[axis] % W != 0:
         raise ValueError(
             f"scatter: dim {axis} of size {full.shape[axis]} not divisible "
@@ -195,7 +197,7 @@ def all_to_all_single(x, axis_name: str = "dp", split_axis: int = 0,
     Backward is the inverse all_to_all (self-transposing collective)."""
     from jax import lax
 
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     if x.shape[split_axis] % W != 0:
         raise ValueError(
             f"all_to_all_single: dim {split_axis} of size "

@@ -35,7 +35,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from .._compat import tpu_compiler_params
+
 NEG_INF = -1e30
+
+
+def _compiler_params(pltpu):
+    """The fwd kernel's (parallel, parallel, arbitrary) grid semantics,
+    via the version-compat `CompilerParams` constructor."""
+    return tpu_compiler_params(
+        dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                             pltpu.ARBITRARY),
+    )
 
 
 def _interpret_default() -> bool:
@@ -281,10 +292,7 @@ def _fwd_streamed(q, k, v, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY),
-        ),
+        compiler_params=_compiler_params(pltpu),
         interpret=interpret,
     )(q, k, v)
     return o, lse
@@ -479,10 +487,7 @@ def _dkdv_call(q, k, v, do, lse, delta, scale, causal, block_q, block_k,
     if _use_streaming(L, D, q.dtype.itemsize):
         from jax.experimental.pallas import tpu as pltpu
 
-        sem = pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY),
-        )
+        sem = _compiler_params(pltpu)
         return pl.pallas_call(
             functools.partial(
                 _bwd_dkdv_kernel_streamed,
@@ -547,10 +552,7 @@ def _dq_call(q, k, v, do, lse, delta, scale, causal, block_q, block_k,
     if _use_streaming(L, D, q.dtype.itemsize):
         from jax.experimental.pallas import tpu as pltpu
 
-        sem = pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY),
-        )
+        sem = _compiler_params(pltpu)
         return pl.pallas_call(
             functools.partial(
                 _bwd_dq_kernel_streamed,

@@ -26,6 +26,8 @@ from typing import Callable, Optional
 
 import jax
 
+from .._compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 
@@ -86,7 +88,7 @@ def ring_attention(
     import jax.numpy as jnp
     from jax import lax
 
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     r = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -227,13 +229,16 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale, bq, bk,
     import jax.numpy as jnp
     from jax import lax
 
-    W = lax.axis_size(axis_name)
-    r = lax.axis_index(axis_name)
+    W = _axis_size(axis_name)
+    # axis_index only exists on the causal path: non-causal shards never
+    # consult their ring position, and older XLA rejects the leftover
+    # partition-id op when SPMD-partitioning the non-causal module
+    r = lax.axis_index(axis_name) if causal else 0
     perm = [(i, (i + 1) % W) for i in range(W)]
 
     def body(s, carry):
         o, lse, k_cur, v_cur = carry
-        src = (r - s) % W
+        src = (r - s) % W if causal else s
         o_b, lse_b = _ring_flash_partial(
             q, k_cur, v_cur, src, r, causal, scale, bq, bk, interpret
         )
@@ -271,8 +276,9 @@ def _ring_core_bwd(axis_name, causal, scale, bq, bk, interpret, res, do):
     from ..ops.flash_attention import _dkdv_call, _dq_call
 
     q, k, v, o, lse = res
-    W = lax.axis_size(axis_name)
-    r = lax.axis_index(axis_name)
+    W = _axis_size(axis_name)
+    # see _ring_flash_fwd_loop: ring position is a causal-only input
+    r = lax.axis_index(axis_name) if causal else 0
     perm = [(i, (i + 1) % W) for i in range(W)]
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
@@ -343,7 +349,7 @@ def ulysses_attention(
     import jax.numpy as jnp
     from jax import lax
 
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     B, Ll, H, D = q.shape
     if H % W != 0:
         raise ValueError(f"heads {H} not divisible by axis size {W}")

@@ -27,6 +27,8 @@ from __future__ import annotations
 import functools
 from typing import Callable, Optional, Tuple
 
+from .._compat import axis_size as _axis_size
+
 
 def _topk_routing(logits, n_experts: int, capacity: int, k: int = 1):
     """Token-choice top-k routing (Switch k=1, GShard/Mixtral k>1).
@@ -98,7 +100,7 @@ def moe_mlp(
     T, D = x.shape
     E_local = w_up.shape[0]
     if axis_name is not None:
-        ep = lax.axis_size(axis_name)
+        ep = _axis_size(axis_name)
     else:
         ep = 1
     E = E_local * ep

@@ -43,6 +43,8 @@ from __future__ import annotations
 import functools
 from typing import Callable, Optional
 
+from .._compat import axis_size as _axis_size
+
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str = "pp"):
     """GPipe forward inside shard_map.
@@ -58,7 +60,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str = "pp"):
     import jax.numpy as jnp
     from jax import lax
 
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = x.shape[0]
     mb_shape = x.shape[1:]
@@ -109,7 +111,7 @@ def pipeline_apply_interleaved(
     import jax.numpy as jnp
     from jax import lax
 
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     V = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
     M = x.shape[0]
@@ -174,7 +176,7 @@ def pipeline_train_1f1b(
     import jax.numpy as jnp
     from jax import lax
 
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = x.shape[0]
     mb_shape = x.shape[1:]

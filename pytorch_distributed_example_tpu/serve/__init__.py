@@ -12,6 +12,14 @@ pool utilization included — exposed over the debug HTTP frontend
 (`metrics.py`). `benchmarks/serve_bench.py` measures goodput vs a
 static-batch baseline, paged-vs-dense cache memory per request, chunked
 vs unchunked long-prompt-burst TTFT, and 1→N-chip TP goodput scaling.
+
+Multi-tenant + elastic (ROADMAP item 5): priority classes with
+weighted admission, class-ordered overload shedding and cross-class
+preemption (`queue.py` / `engine.py` ``classes=``), and drain /
+checkpoint / restore of the serving plane through the incarnation-
+scoped store so an elastic-agent restart or resize replays interrupted
+requests token-identically (`elastic.py`), with per-class and
+recovery-time metrics on ``/serve``.
 """
 
 from .bucketing import bucket_for, bucket_lengths  # noqa: F401
@@ -20,10 +28,22 @@ from .cache import (  # noqa: F401
     SlotKVCache,
     init_paged_cache,
 )
-from .decode import paged_programs, slot_programs  # noqa: F401
+from .decode import (  # noqa: F401
+    paged_programs,
+    slot_programs,
+    sync_slot_lanes,
+)
+from .elastic import (  # noqa: F401
+    drain_requested,
+    load_serve_state,
+    restore_into,
+    save_serve_state,
+    signal_drain,
+)
 from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
 from .queue import (  # noqa: F401
+    ClassSpec,
     Completion,
     QueueFullError,
     Request,

@@ -38,7 +38,24 @@ from typing import Optional
 from ..models.generate import init_cache, sample_logits
 from .cache import land_slot
 
-__all__ = ["slot_programs", "paged_programs"]
+__all__ = ["slot_programs", "paged_programs", "sync_slot_lanes"]
+
+
+def sync_slot_lanes(lengths, tokens, rngs):
+    """Step-boundary quiesce — the serve DRAIN seam.
+
+    Every per-slot state lane is buffer-donated through the compiled
+    step, so "the step returned" does not mean "the device finished
+    writing": a drain that serializes engine state while the last
+    dispatch is still in flight would snapshot a boundary that never
+    existed. Blocking on the lanes (the step's final outputs) orders
+    the drain after everything the step wrote, pool included — after
+    this returns, the engine's host-side bookkeeping IS the state.
+    Returns the same (lengths, tokens, rngs) triple, materialized."""
+    import jax
+
+    jax.block_until_ready((lengths, tokens, rngs))
+    return lengths, tokens, rngs
 
 
 @functools.lru_cache(maxsize=32)

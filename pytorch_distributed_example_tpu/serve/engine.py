@@ -43,11 +43,36 @@ every chip.
 
 Fault surface: `serve.admit` before each admission, `serve.
 prefill_chunk` before each prompt chunk, `serve.step` before each
-decode batch (all in `faults.KNOWN_POINTS`). Transient faults requeue
-the affected requests at the queue head and the engine carries on;
-because each request replays from its own seed, a greedy request's
-output is token-identical across any number of mid-stream requeues
-(`tests/test_serve.py` / `tests/test_serve_paged.py` chaos cases).
+decode batch, `serve.drain` before a drain snapshot (all in
+`faults.KNOWN_POINTS`). Transient faults requeue the affected requests
+at the queue head and the engine carries on; because each request
+replays from its own seed, a greedy request's output is token-identical
+across any number of mid-stream requeues (`tests/test_serve.py` /
+`tests/test_serve_paged.py` chaos cases).
+
+Multi-tenant SLO-aware admission (``classes=``): requests carry a
+tenant id and a priority class; the queue admits by smooth weighted
+round-robin across classes and, under a full queue, sheds the WORST
+class present instead of collapsing FIFO (see `serve/queue.py`).
+Cross-class preemption (`class_preemption=True`, the default when
+classes are configured) lets waiting higher-priority work evict the
+youngest in-flight request of a strictly worse class — the evictee
+requeues and replays token-identically off its seed, exactly like a
+pool-pressure preemption — and pool-pressure eviction itself becomes
+class-aware (worst class first, youngest within it). Together these
+protect the high class's p99 TTFT under overload while the low class
+absorbs the sheds (the `serve_bench.py --trace multitenant` row).
+
+Elastic serving: `drain()` stops at a step boundary — quiesces the
+device lanes through the `serve/decode.py` drain seam, requeues all
+in-flight work (replayable from seeds), and returns a JSON-able state
+snapshot (queue contents + per-request emitted-token counts + the
+checkpoint timestamp). `serve/elastic.py` persists that snapshot into
+the incarnation-scoped store with the PR 1 CRC conventions and
+restores it into a fresh engine on the re-formed gang — possibly at a
+different world size / TP degree, since replay-from-seed carries no
+device state. The restored engine reports a first-class RECOVERY
+metric (drain → first post-restore token) on `/serve`.
 
 Synchronous single-owner design: one thread calls `submit()`/`step()`/
 `run()`; `ServeMetrics` is internally locked so the debug HTTP frontend
@@ -66,9 +91,16 @@ from .. import faults
 from ..types import DistError
 from .bucketing import bucket_for, bucket_lengths
 from .cache import PagedKVCache
-from .decode import paged_programs
+from .decode import paged_programs, sync_slot_lanes
 from .metrics import ServeMetrics
-from .queue import Completion, QueueFullError, Request, RequestQueue
+from .queue import (
+    DEFAULT_CLASS,
+    ClassSpec,
+    Completion,
+    QueueFullError,
+    Request,
+    RequestQueue,
+)
 
 __all__ = ["ServeEngine"]
 
@@ -108,6 +140,8 @@ class ServeEngine:
         tp_axis: str = "tp",
         kv_quant: bool = False,
         conservative_admission: bool = False,
+        classes: Optional[Dict[str, ClassSpec]] = None,
+        class_preemption: bool = True,
     ):
         self.model = model
         self.params = params["params"] if "params" in params else params
@@ -120,9 +154,31 @@ class ServeEngine:
             model, slots, num_blocks=pool_blocks, block_size=block_size,
             quantized=kv_quant,
         )
-        self.queue = RequestQueue(max_depth=max_queue_depth)
-        self.metrics = metrics or ServeMetrics(clock=clock, slots=slots)
+        # multi-tenant classes: weighted admission + class-ordered shed
+        # in the queue; cross-class preemption here. None = the single
+        # default class (PR 4 FIFO semantics, bit-for-bit).
+        self.classes = dict(classes) if classes else None
+        self.class_preemption = bool(classes) and class_preemption
+        self.queue = RequestQueue(
+            max_depth=max_queue_depth, classes=self.classes
+        )
+        self.metrics = metrics or ServeMetrics(
+            clock=clock, slots=slots, classes=self.classes
+        )
         self.metrics.slots = slots
+        # displaced-by-class sheds (queued low-class work evicted by a
+        # higher-class put) — exposed so drivers can account for
+        # requests that will never complete. BOUNDED: only the newest
+        # _max_shed_kept victims are kept (a long-lived engine under
+        # sustained overload must not accumulate prompt arrays forever;
+        # totals live in the per-class shed metrics).
+        self.shed_requests: Dict[str, Request] = {}
+        self._max_shed_kept = 1024
+        # elastic restore bookkeeping: set by serve/elastic.py's
+        # restore_into; the first post-restore emitted token closes the
+        # recovery window (drain timestamp -> first token served)
+        self._recovery_anchor: Optional[float] = None
+        self._recovery_meta: tuple = (0, 0, -1)
         self.buckets = bucket_lengths(self.cfg.max_seq_len, min_bucket)
         if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
             raise ValueError(
@@ -192,10 +248,15 @@ class ServeEngine:
         rid: Optional[str] = None,
         seed: int = 0,
         arrival_time: Optional[float] = None,
+        tenant: str = "",
+        klass: str = DEFAULT_CLASS,
     ) -> str:
         """Enqueue one generation request; returns its request id.
         Raises `QueueFullError` (counted in metrics as a shed) when
-        bounded admission is on and the queue is at depth.
+        bounded admission is on and the request's class is the worst
+        present; a HIGHER-class submit into a full queue instead
+        displaces the newest worst-class queued request (recorded in
+        `shed_requests` + per-class metrics) and succeeds.
 
         `arrival_time` (engine-clock seconds) is trace-replay support:
         a single-threaded replay driver can only call submit() between
@@ -208,6 +269,8 @@ class ServeEngine:
             max_new_tokens=max_new_tokens,
             rid=rid or "",
             seed=seed,
+            tenant=tenant,
+            klass=klass,
         )
         L = len(req.prompt)
         if L < 1:
@@ -229,11 +292,19 @@ class ServeEngine:
             self.clock() if arrival_time is None else arrival_time
         )
         try:
-            self.queue.put(req)
+            victim = self.queue.put(req)
         except QueueFullError:
-            self.metrics.record_shed()
+            self.metrics.record_shed(req.klass)
             raise
-        self.metrics.record_submit(req.arrival_time)
+        if victim is not None:
+            # class-ordered overload shed: a queued worse-class request
+            # made room for this one (it never ran; callers see it in
+            # shed_requests, metrics count it against ITS class)
+            self.shed_requests[victim.rid] = victim
+            while len(self.shed_requests) > self._max_shed_kept:
+                self.shed_requests.pop(next(iter(self.shed_requests)))
+            self.metrics.record_shed(victim.klass)
+        self.metrics.record_submit(req.arrival_time, req.klass)
         return req.rid
 
     def _chunk_len(self, L: int) -> int:
@@ -247,55 +318,162 @@ class ServeEngine:
         return bucket_for(L, self.buckets)
 
     def _admit(self) -> int:
-        """Backfill free slots from the queue head (continuous batching:
+        """Backfill free slots from the queue (continuous batching:
         called at the top of every step, so retirement and admission
-        interleave mid-stream). Admission stops when slots run out OR
-        the pool cannot hold the next request's first chunk — the
-        allocate-on-write backpressure gate. Returns the number of
-        requests admitted this round."""
+        interleave mid-stream). The queue's weighted round-robin picks
+        the candidate; when that candidate cannot acquire resources,
+        strictly-HIGHER-priority class heads also get a try (they may
+        preempt a worse class's in-flight work — `_class_preempt_for`),
+        so overload never wedges the high class behind a low-class head
+        that cannot make progress. Admission stops when no candidate
+        can acquire a slot + first-chunk blocks — the allocate-on-write
+        backpressure gate. Returns the number admitted this round."""
         admitted = 0
         while True:
-            if not self.queue:
+            candidates = self._admission_candidates()
+            if not candidates:
                 return admitted
-            head = self.queue.peek()
-            if head is None:
+            progressed = False
+            for head in candidates:
+                outcome = self._try_admit(head)
+                if outcome == "admitted":
+                    admitted += 1
+                    progressed = True
+                    break
+                if outcome == "stop":
+                    return admitted
+                # "blocked": this candidate cannot acquire resources —
+                # a better class may still preempt its way in
+            if not progressed:
                 return admitted
-            head_len = len(head.prompt)
-            need = self.cache.blocks_for(
-                min(self._chunk_len(head_len), head_len)
+
+    def _admission_candidates(self) -> List[Request]:
+        """The SWRR-selected head first, then heads of STRICTLY better
+        priority classes, best-first (single-class queues: just the
+        head). Worse classes never bypass a blocked candidate — they
+        could only squeeze into space the blocked class will preempt
+        right back, churning admissions without progress."""
+        heads = self.queue.class_heads()
+        if not heads:
+            return []
+        sel = self.queue.peek()
+        if sel is None or not self.classes:
+            return [sel] if sel is not None else []
+        sp = self.classes[sel.klass].priority
+        rest = sorted(
+            (
+                r
+                for r in heads.values()
+                if r is not sel and self.classes[r.klass].priority < sp
+            ),
+            key=lambda r: self.classes[r.klass].priority,
+        )
+        return [sel] + rest
+
+    def _try_admit(self, head: Request) -> str:
+        """Acquire slot + first-chunk blocks for `head` (class-preempting
+        worse in-flight work while allowed) and admit it. Returns
+        "admitted", "blocked" (resources unavailable for THIS candidate),
+        or "stop" (end the whole admission round).
+
+        ALL gates precheck — before anyone is evicted — that evicting
+        the available worse-class victims could satisfy them JOINTLY
+        (eviction frees a victim's slot, blocks, and reservation at
+        once, so each gate's feasibility at the evict-everything bound
+        is monotone and the per-gate prechecks compose). A candidate
+        that would stay blocked after evicting every victim must not
+        evict at all — otherwise each admission round would pointlessly
+        kill worse-class work (possibly work admitted moments earlier),
+        churning requeues without any gold progress."""
+        head_len = len(head.prompt)
+        need = self.cache.blocks_for(min(self._chunk_len(head_len), head_len))
+        victims = self._class_victims(head)
+        if need > self.cache.free_blocks + sum(
+            len(self.cache.slot_blocks(s)) for s in victims
+        ):
+            return "blocked"  # pool backpressure: wait for retires
+        if self.conservative_admission:
+            worst = self.cache.blocks_for(head_len + head.max_new_tokens)
+            releasable = sum(
+                self._worst_blocks(self._slot_req[s]) for s in victims
             )
-            if need > self.cache.free_blocks:
-                return admitted  # pool backpressure: wait for retires
-            if self.conservative_admission:
-                worst = self.cache.blocks_for(
-                    head_len + head.max_new_tokens
-                )
-                if self._reserved + worst > self.cache.num_blocks:
-                    return admitted  # worst-case reservation gate
+            if self._reserved - releasable + worst > self.cache.num_blocks:
+                return "blocked"  # worst-case reservation gate
+        if (
+            len(self.cache.active_slots) >= self.cache.slots
+            and not victims
+        ):
+            return "blocked"  # slot pressure with nothing evictable
+        # feasible: now acquire, evicting as needed
+        while need > self.cache.free_blocks:
+            if not self._class_preempt_for(head):
+                return "blocked"
+        if self.conservative_admission:
+            while self._reserved + worst > self.cache.num_blocks:
+                if not self._class_preempt_for(head):
+                    return "blocked"
+        slot = self.cache.allocate()
+        while slot is None:
+            if not self._class_preempt_for(head):
+                return "blocked"
             slot = self.cache.allocate()
-            if slot is None:
-                return admitted
-            req = self.queue.pop()
-            if req is None:  # racing submitter drained between checks
-                self.cache.free(slot)
-                return admitted
-            try:
-                faults.fire("serve.admit", rid=req.rid)
-            except _TRANSIENT:
-                # transient admission fault: the request goes back to the
-                # HEAD (arrival order preserved) and this round stops —
-                # the next step() retries
-                self.cache.free(slot)
-                req.requeues += 1
-                self.queue.requeue_front(req)
-                self.metrics.record_requeue()
-                return admitted
-            self._slot_req[slot] = req
-            self._slot_tokens[slot] = []
-            self._prefilling[slot] = _Prefill(req)
-            self._reserved += self._worst_blocks(req)
-            self.metrics.record_admit()
-            admitted += 1
+        if not self.queue.pop_specific(head):
+            # racing submitter drained it between checks
+            self.cache.free(slot)
+            return "stop"
+        req = head
+        try:
+            faults.fire("serve.admit", rid=req.rid)
+        except _TRANSIENT:
+            # transient admission fault: the request goes back to the
+            # HEAD (arrival order preserved) and this round stops —
+            # the next step() retries
+            self.cache.free(slot)
+            req.requeues += 1
+            self.queue.requeue_front(req)
+            self.metrics.record_requeue()
+            return "stop"
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = []
+        self._prefilling[slot] = _Prefill(req)
+        self._reserved += self._worst_blocks(req)
+        self.metrics.record_admit()
+        return "admitted"
+
+    def _class_victims(self, head: Request) -> List[int]:
+        """Slots holding in-flight work of a class STRICTLY below
+        `head`'s priority — what cross-class preemption may evict
+        (equal-or-better classes never; same-class pressure stays
+        ordinary backpressure)."""
+        if not self.class_preemption:
+            return []
+        hp = self.classes[head.klass].priority
+        return [
+            s
+            for s in range(self.cache.slots)
+            if self._slot_req[s] is not None
+            and self.classes[self._slot_req[s].klass].priority > hp
+        ]
+
+    def _class_preempt_for(self, head: Request) -> bool:
+        """Cross-class preemption: evict the youngest in-flight request
+        of the WORST class strictly below `head`'s priority; the evictee
+        requeues at its class head and replays token-identically from
+        its seed. False when no victim exists."""
+        victims = self._class_victims(head)
+        if not victims:
+            return False
+        victim = max(
+            victims,
+            key=lambda s: (
+                self.classes[self._slot_req[s].klass].priority,
+                self._slot_req[s].arrival_time,
+            ),
+        )
+        klass = self._slot_req[victim].klass
+        self._evict(victim, requeue_counter=False)
+        self.metrics.record_class_preempt(klass)
+        return True
 
     def _worst_blocks(self, req: Request) -> int:
         """A request's worst-case block footprint (prompt + full token
@@ -321,9 +499,15 @@ class ServeEngine:
         budget = self.prefill_chunk_tokens
         spent = 0
         while self._prefilling:
+            # class priority outranks shortest-remaining: a gold prompt's
+            # chunks never queue behind bronze prefill work (single-class
+            # engines: pure shortest-remaining-first, the PR 6 policy)
             slot = min(
                 self._prefilling,
                 key=lambda s: (
+                    self.classes[self._prefilling[s].req.klass].priority
+                    if self.classes
+                    else 0,
                     len(self._prefilling[s].req.prompt)
                     - self._prefilling[s].pos,
                     self._prefilling[s].req.arrival_time,
@@ -392,6 +576,7 @@ class ServeEngine:
             self._slot_tokens[slot] = [first]
             now = self.clock()
             req.first_token_time = now
+            self._note_recovery(now)
             if (self.eos_id is not None and first == self.eos_id) or (
                 req.max_new_tokens == 1
             ):
@@ -408,10 +593,11 @@ class ServeEngine:
     # -- pool pressure -----------------------------------------------------
     def _ensure_or_preempt(self, slot: int, upto_pos: int) -> bool:
         """Grow `slot`'s block table to cover `upto_pos`, evicting the
-        YOUNGEST active request (by arrival) while the pool is dry.
-        Returns False when the grower itself was the youngest and got
-        evicted. Deadlock-free: submit() guarantees any single request's
-        worst case fits the pool, so the oldest request always wins."""
+        WORST-CLASS then youngest active request while the pool is dry
+        (single-class engines: plain youngest-first, the PR 6 policy).
+        Returns False when the grower itself got evicted. Deadlock-free:
+        submit() guarantees any single request's worst case fits the
+        pool, so the oldest request of the best class always wins."""
         while not self.cache.ensure_blocks(slot, upto_pos):
             victims = [
                 s
@@ -419,10 +605,17 @@ class ServeEngine:
                 if self._slot_req[s] is not None
             ]
             victim = max(
-                victims, key=lambda s: self._slot_req[s].arrival_time
+                victims,
+                key=lambda s: (
+                    self.classes[self._slot_req[s].klass].priority
+                    if self.classes
+                    else 0,
+                    self._slot_req[s].arrival_time,
+                ),
             )
+            klass = self._slot_req[victim].klass
             self._evict(victim, requeue_counter=False)
-            self.metrics.record_preempt()
+            self.metrics.record_preempt(klass=klass)
             if victim == slot:
                 return False
         return True
@@ -452,7 +645,11 @@ class ServeEngine:
         work remains (active slots, prefills, or queued requests)."""
         self._admit()
         self.metrics.record_step(
-            self.queue.depth, len(self.cache.active_slots)
+            self.queue.depth,
+            len(self.cache.active_slots),
+            class_depths=(
+                self.queue.class_depths() if self.classes else None
+            ),
         )
         self.metrics.record_pool(
             self.cache.live_blocks,
@@ -561,14 +758,98 @@ class ServeEngine:
             tpot_s=tpot,
             e2e_s=now - req.arrival_time,
             requeues=req.requeues,
+            tenant=req.tenant,
+            klass=req.klass,
         )
         self.completions[req.rid] = comp
-        self.metrics.record_complete(now, n, comp.ttft_s, tpot, comp.e2e_s)
+        self.metrics.record_complete(
+            now, n, comp.ttft_s, tpot, comp.e2e_s, klass=req.klass
+        )
         self._slot_req[slot] = None
         self._slot_tokens[slot] = []
         self._decoding.discard(slot)
         self.cache.free(slot)  # slot AND its blocks return to the pool
         self._reserved -= self._worst_blocks(req)
+
+    def snapshot_state(self) -> Dict:
+        """Non-destructive restartable snapshot at a step boundary —
+        the PERIODIC checkpointing path (crash consistency while the
+        engine keeps serving; a kill between checkpoints only costs the
+        replay of work the last snapshot already covers).
+
+        JSON-able payload: every unfinished request's full metadata
+        (prompt, seed, token budget, tenant/class, arrival, requeue
+        count) — in-flight requests first in arrival order, exactly the
+        order `requeue_inflight` would restore — plus the in-flight
+        emitted-token ledger (the tokens a restart throws away and
+        replays) and the checkpoint timestamp anchoring the
+        recovery-time metric.
+
+        `serve.drain` fires BEFORE any state is read: a transient
+        injected fault aborts the snapshot with the engine untouched."""
+        faults.fire(
+            "serve.drain",
+            queued=self.queue.depth,
+            active=self.num_active,
+        )
+        inflight = sorted(
+            (
+                self._slot_req[s]
+                for s in range(self.cache.slots)
+                if self._slot_req[s] is not None
+            ),
+            key=lambda r: r.arrival_time,
+        )
+        emitted = {
+            self._slot_req[s].rid: len(self._slot_tokens[s])
+            for s in range(self.cache.slots)
+            if self._slot_req[s] is not None
+        }
+        heads, tails = self.queue.snapshot_split()
+        return {
+            "version": 1,
+            "checkpoint_time": float(self.clock()),
+            "emitted": emitted,
+            # "requests": engine-accepted work (in-flight + requeued) —
+            # restored exempt from bounds; "queued": the submitted-tail
+            # backlog — restored into the bounded, class-sheddable tails
+            "requests": [r.to_state() for r in inflight + heads],
+            "queued": [r.to_state() for r in tails],
+        }
+
+    def drain(self) -> Dict:
+        """Stop serving at a step boundary and capture restartable
+        state — the elastic-agent restart/resize path.
+
+        `snapshot_state()` plus the terminal half: quiesce the device
+        lanes through the `serve/decode.py` drain seam (every donated
+        buffer materialized — no program may still be writing the pool
+        when the process exits) and requeue all in-flight work (each
+        request replays token-identically from its seed, so dropping
+        device state loses nothing but the replay time). The engine
+        itself stays usable — a cancelled drain just keeps serving."""
+        state = self.snapshot_state()
+        (
+            self._dev_lengths,
+            self._dev_tokens,
+            self._dev_rngs,
+        ) = sync_slot_lanes(
+            self._dev_lengths, self._dev_tokens, self._dev_rngs
+        )
+        self.requeue_inflight()
+        return state
+
+    def _note_recovery(self, now: float) -> None:
+        """First emitted token after an elastic restore closes the
+        recovery window (drain timestamp -> token served on the
+        re-formed gang)."""
+        if self._recovery_anchor is None:
+            return
+        restored, replayed, gen = self._recovery_meta
+        self.metrics.record_recovery(
+            now - self._recovery_anchor, restored, replayed, gen
+        )
+        self._recovery_anchor = None
 
     def requeue_inflight(self) -> int:
         """Drain every in-flight request (decoding AND mid-prefill) back

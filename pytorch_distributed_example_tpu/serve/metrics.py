@@ -10,6 +10,14 @@ dense per-slot layout's constant — the runtime-observable form of the
 paged cache's memory claim). A `clock` injection point keeps the
 accounting testable with a fake clock; `snapshot()` returns plain JSON
 for the debug HTTP frontend (`utils/debug_http.py` route ``/serve``).
+
+Multi-tenant serving adds PER-CLASS breakdowns (completed / shed /
+preempted / TTFT percentiles / SLO attainment per priority class — the
+evidence that the overload controller protects the high class while the
+low class absorbs the sheds) and a RECOVERY block: every elastic
+restore records how long the serving plane was dark (drain/death →
+first token on the re-formed gang), how many requests the checkpoint
+carried back, and how many already-emitted tokens had to replay.
 """
 
 from __future__ import annotations
@@ -18,6 +26,8 @@ import threading
 import time
 from collections import deque
 from typing import Dict, Iterable, Optional
+
+from .queue import DEFAULT_CLASS, ClassSpec
 
 __all__ = ["ServeMetrics", "percentile"]
 
@@ -43,16 +53,32 @@ class ServeMetrics:
         clock=time.monotonic,
         slots: int = 0,
         max_latency_samples: int = 2048,
+        classes: Optional[Dict[str, ClassSpec]] = None,
     ):
         self.clock = clock
         self.slots = slots
         self._lock = threading.Lock()
+        self._max_latency_samples = max_latency_samples
         self.submitted = 0
         self.admitted = 0  # admission ATTEMPTS (a requeued request re-admits)
         self.completed = 0
         self.requeued = 0
         self.shed = 0  # bounded-admission rejections (never enqueued)
         self.preempted = 0  # pool-pressure evictions (requeued, will replay)
+        self.class_preempted = 0  # cross-CLASS evictions (priority inversion)
+        # per-class breakdowns; classes may also appear lazily (a request
+        # naming a class the snapshot has not seen simply opens one)
+        self._classes: Dict[str, ClassSpec] = dict(classes or {})
+        self._by_class: Dict[str, Dict] = {}
+        for k in self._classes:
+            self._class_state(k)
+        # elastic recovery: restores into THIS engine incarnation
+        self.restores = 0
+        self.requests_restored = 0
+        self.tokens_replayed = 0
+        self.last_recovery_s = 0.0
+        self.restored_generation = -1
+        self._queue_class_depths: Dict[str, int] = {}
         self.steps = 0
         # paged-pool gauges (last observation) + time-mean accumulators
         self.pool_blocks_live = 0
@@ -79,10 +105,28 @@ class ServeMetrics:
         self._first_submit: Optional[float] = None
         self._last_complete: Optional[float] = None
 
+    def _class_state(self, klass: str) -> Dict:
+        """Per-class accumulator (caller holds the lock or is __init__)."""
+        st = self._by_class.get(klass)
+        if st is None:
+            st = {
+                "submitted": 0,
+                "completed": 0,
+                "shed": 0,
+                "preempted": 0,
+                "tokens": 0,
+                "slo_met": 0,
+                "ttft": deque(maxlen=self._max_latency_samples),
+                "e2e": deque(maxlen=self._max_latency_samples),
+            }
+            self._by_class[klass] = st
+        return st
+
     # -- recording hooks (engine-driven) -----------------------------------
-    def record_submit(self, t: float) -> None:
+    def record_submit(self, t: float, klass: str = DEFAULT_CLASS) -> None:
         with self._lock:
             self.submitted += 1
+            self._class_state(klass)["submitted"] += 1
             if self._first_submit is None:
                 self._first_submit = t
 
@@ -90,11 +134,20 @@ class ServeMetrics:
         with self._lock:
             self.admitted += 1
 
-    def record_step(self, queue_depth: int, slots_active: int) -> None:
+    def record_step(
+        self,
+        queue_depth: int,
+        slots_active: int,
+        class_depths: Optional[Dict] = None,
+    ) -> None:
         with self._lock:
             self.steps += 1
             self.queue_depth = queue_depth
             self.slots_active = slots_active
+            if class_depths is not None:
+                self._queue_class_depths = {
+                    k: int(sum(v)) for k, v in class_depths.items()
+                }
             self.peak_slots_active = max(self.peak_slots_active, slots_active)
             if self.slots:
                 self._occupancy_steps += slots_active / self.slots
@@ -103,15 +156,45 @@ class ServeMetrics:
         with self._lock:
             self.requeued += n
 
-    def record_shed(self) -> None:
-        """One bounded-admission rejection (QueueFullError at submit)."""
+    def record_shed(self, klass: str = DEFAULT_CLASS) -> None:
+        """One overload shed: a bounded-admission rejection OR a queued
+        low-class request displaced by higher-class work."""
         with self._lock:
             self.shed += 1
+            self._class_state(klass)["shed"] += 1
 
-    def record_preempt(self, n: int = 1) -> None:
+    def record_preempt(self, n: int = 1, klass: str = DEFAULT_CLASS) -> None:
         """Pool-pressure evictions: requests requeued to free blocks."""
         with self._lock:
             self.preempted += n
+            self._class_state(klass)["preempted"] += n
+
+    def record_class_preempt(self, klass: str = DEFAULT_CLASS) -> None:
+        """A cross-class eviction: a low-class in-flight request gave
+        its slot/blocks to waiting higher-class work (it requeues and
+        replays token-identically, like any preemption)."""
+        with self._lock:
+            self.class_preempted += 1
+            self._class_state(klass)["preempted"] += 1
+
+    def record_recovery(
+        self,
+        recovery_s: float,
+        requests_restored: int,
+        tokens_replayed: int,
+        generation: int,
+    ) -> None:
+        """One elastic restore landed: the re-formed gang served its
+        first post-restore token `recovery_s` after the checkpoint was
+        cut (shared-timebase clocks on both sides — the drain stamps the
+        checkpoint, the restored engine's first completed step closes
+        the window)."""
+        with self._lock:
+            self.restores += 1
+            self.requests_restored += requests_restored
+            self.tokens_replayed += tokens_replayed
+            self.last_recovery_s = recovery_s
+            self.restored_generation = generation
 
     def record_pool(
         self,
@@ -155,6 +238,7 @@ class ServeMetrics:
         ttft_s: float,
         tpot_s: float,
         e2e_s: float,
+        klass: str = DEFAULT_CLASS,
     ) -> None:
         """All latency samples land here, at COMPLETION — an admission
         attempt aborted by a mid-stream requeue leaves no sample, so the
@@ -165,6 +249,14 @@ class ServeMetrics:
             self.ttft_s.append(ttft_s)
             self.tpot_s.append(tpot_s)
             self.e2e_s.append(e2e_s)
+            st = self._class_state(klass)
+            st["completed"] += 1
+            st["tokens"] += n_tokens
+            st["ttft"].append(ttft_s)
+            st["e2e"].append(e2e_s)
+            spec = self._classes.get(klass)
+            if spec is not None and spec.ttft_slo_s is not None:
+                st["slo_met"] += int(ttft_s <= spec.ttft_slo_s)
             self._last_complete = t
 
     # -- reporting ---------------------------------------------------------
@@ -208,6 +300,33 @@ class ServeMetrics:
                 self._bytes_per_req_sum / self._bytes_per_req_samples
                 if self._bytes_per_req_samples else 0.0
             )
+            by_class = {}
+            for k, st in sorted(self._by_class.items()):
+                spec = self._classes.get(k)
+                row = {
+                    "queue_depth": self._queue_class_depths.get(k, 0),
+                    "submitted": st["submitted"],
+                    "completed": st["completed"],
+                    "shed": st["shed"],
+                    "preempted": st["preempted"],
+                    "tokens_completed": st["tokens"],
+                    "ttft_p50_ms": round(
+                        percentile(st["ttft"], 50) * 1e3, 3
+                    ),
+                    "ttft_p99_ms": round(
+                        percentile(st["ttft"], 99) * 1e3, 3
+                    ),
+                    "e2e_p99_ms": round(percentile(st["e2e"], 99) * 1e3, 3),
+                }
+                if spec is not None:
+                    row["priority"] = spec.priority
+                    row["weight"] = spec.weight
+                    if spec.ttft_slo_s is not None:
+                        row["ttft_slo_ms"] = round(spec.ttft_slo_s * 1e3, 3)
+                        row["slo_attainment"] = round(
+                            st["slo_met"] / st["completed"], 4
+                        ) if st["completed"] else 0.0
+                by_class[k] = row
             snap = {
                 "submitted": self.submitted,
                 "admitted": self.admitted,
@@ -215,6 +334,15 @@ class ServeMetrics:
                 "requeued": self.requeued,
                 "shed": self.shed,
                 "preempted": self.preempted,
+                "class_preempted": self.class_preempted,
+                "classes": by_class,
+                "recovery": {
+                    "restores": self.restores,
+                    "requests_restored": self.requests_restored,
+                    "tokens_replayed": self.tokens_replayed,
+                    "last_recovery_s": round(self.last_recovery_s, 6),
+                    "restored_generation": self.restored_generation,
+                },
                 "steps": self.steps,
                 "queue_depth": self.queue_depth,
                 "slots": self.slots,
