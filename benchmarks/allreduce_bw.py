@@ -24,13 +24,21 @@ Torch-reference equivalent: the gloo ring allreduce the reference's
 toy/main.py exercises (SURVEY.md §2.2 N8/N9). Here each collective is one
 compiled XLA program over the ICI/host mesh (backends/xla.py).
 
+`--planner` is the TOPOLOGY-AWARE-PLANNER row (plan/, ISSUE 9): the same
+public all_reduce dispatch timed stock vs planner-enabled per sweep
+size, with the winning algorithm chosen from the measured probe table
+(persisted on disk keyed by topology; `--no-probe-cache` bypasses).
+Self-persists as `allreduce_planner` on TPU.
+
 Usage: python benchmarks/allreduce_bw.py [--max-mb 256] [--op all_reduce]
        python benchmarks/allreduce_bw.py --op quant [--wire int8]
+       python benchmarks/allreduce_bw.py --planner [--no-probe-cache]
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -174,6 +182,127 @@ def run_quant(args, tdx, W):
     return rows
 
 
+def run_planner(args, tdx, W):
+    """The `--planner` A/B (ISSUE 9): the SAME public `tdx.all_reduce`
+    dispatch timed with the topology-aware planner off (stock psum
+    lowering) and on (probe-chosen schedule per size bucket), per sweep
+    size. The winning algorithm comes from the measured probe table —
+    when "onepass" wins a bucket the planner dispatches the stock
+    lowering and the ratio honestly reads ~1.0x. Summary value is the
+    best planner/stock ratio over sizes where a SYNTHESIZED schedule
+    was chosen; the acceptance target is >= 1.3x for at least one
+    (size, world) regime."""
+    import time as _time
+
+    import numpy as np
+
+    from benchmarks.common import device_sync, emit, on_tpu, persist_result
+    from pytorch_distributed_example_tpu import plan
+
+    g = tdx.distributed._resolve(None)
+    if W <= 1:
+        # single visible device: nothing to plan over — emit the
+        # degenerate summary instead of tripping over an empty
+        # candidate set inside the sweep
+        print(
+            "[allreduce_planner] degenerate run (world=1: nothing to "
+            "plan over); summary is not an acceptance record",
+            file=sys.stderr,
+        )
+        return [emit(
+            "allreduce_planner_summary", 0.0, "x_vs_stock",
+            target=1.3, world=W, degenerate="world=1: nothing to plan over",
+        )]
+    if args.no_probe_cache:
+        os.environ["TDX_PLANNER_PROBE_CACHE"] = ""
+        plan.reset_group(g)
+
+    def timed(run):
+        out = None
+        for _ in range(max(args.warmup, 1)):
+            out = run()
+        device_sync(out)
+        t0 = _time.perf_counter()
+        for _ in range(args.iters):
+            out = run()
+        device_sync(out)
+        return (_time.perf_counter() - t0) / args.iters
+
+    size = int(args.min_kb * 1024)
+    max_size = int(args.max_mb * 1024 * 1024)
+    rows, best = [], None
+    while size <= max_size:
+        n = max(size // 4, 1)
+        flat = tdx.DistTensor.from_rank_fn(
+            lambda r: np.full((n,), float(r), np.float32)
+        )
+
+        def run():
+            tdx.all_reduce(flat)
+            return flat
+
+        plan.enable_for_group(g, False)
+        dt_stock = timed(run)
+        plan.enable_for_group(g, True)
+        dt_plan = timed(run)  # first call probes + compiles; warmup absorbs
+        # report the choice for the plane the timed dispatch actually
+        # took (multiproc gangs lower onto the p2p plane, not XLA)
+        plane = (
+            "plane"
+            if tdx.distributed._world.mode == "multiproc"
+            else "driver"
+        )
+        choice = plan.planner_for_group(g).explain(
+            "all_reduce", size, plane=plane
+        )
+        plan.enable_for_group(g, False)
+        speedup = dt_stock / dt_plan if dt_plan > 0 else 0.0
+        rec = emit(
+            f"allreduce_planner_{_fmt(size)}",
+            size / dt_plan / 1e9,
+            "GB/s",
+            bytes=size,
+            world=W,
+            us=round(dt_plan * 1e6, 1),
+            stock_us=round(dt_stock * 1e6, 1),
+            speedup_x=round(speedup, 3),
+            algorithm=choice["algorithm"],
+            source=choice["source"],
+            probe_timings=choice["timings"],
+        )
+        rows.append(rec)
+        if choice["algorithm"] != "onepass" and (
+            best is None or rec["speedup_x"] > best["speedup_x"]
+        ):
+            best = rec
+        size *= 4
+    degenerate = None
+    if best is None:
+        degenerate = "probe table chose the stock lowering at every size"
+    if degenerate:
+        print(
+            f"[allreduce_planner] degenerate run ({degenerate}); summary "
+            "is not an acceptance record and will not be persisted",
+            file=sys.stderr,
+        )
+    summary = emit(
+        "allreduce_planner_summary",
+        best["speedup_x"] if best and not degenerate else 0.0,
+        "x_vs_stock",
+        best_row=best["metric"] if best else "",
+        best_algorithm=best["algorithm"] if best else "",
+        choice_source=best["source"] if best else "",
+        target=1.3,
+        world=W,
+        topology=choice["topology"],
+        degenerate=degenerate or "",
+        rows=rows,
+    )
+    if on_tpu() and not degenerate:
+        persist_result("allreduce_planner", summary)
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=256.0)
@@ -185,6 +314,16 @@ def main():
         "--wire", choices=WIRES + ["all"], default="all",
         help="--op quant: which wire widths to sweep (f32 always runs "
         "as the ratio base)",
+    )
+    ap.add_argument(
+        "--planner", action="store_true",
+        help="A/B the topology-aware collective planner vs the stock "
+        "lowering over the sweep (probe-chosen algorithms)",
+    )
+    ap.add_argument(
+        "--no-probe-cache", action="store_true",
+        help="--planner: ignore and do not write the on-disk probe "
+        "cache (sets TDX_PLANNER_PROBE_CACHE='')",
     )
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=5)
@@ -199,6 +338,9 @@ def main():
     if not tdx.is_initialized():
         tdx.init_process_group(backend="xla")
     W = tdx.get_world_size()
+
+    if args.planner:
+        return run_planner(args, tdx, W)
 
     if args.op == "quant":
         return run_quant(args, tdx, W)

@@ -83,6 +83,38 @@ def chain_pretrain(
     return params, chain, float(loss)
 
 
+class BwStubGroup:
+    """Minimal ProcessGroup stand-in carrying exactly what the p2p
+    routing layer (`dist._store_send`/`_store_recv`) and the planner's
+    plane executor consult: store, timeout, group name, rank/size, and
+    the group↔global rank maps (identity — the stub IS the world).
+
+    Shared by the p2p bandwidth benches (both the parent process and
+    the spawned child) and the planner probe harness, which previously
+    each carried their own copy-pasted throwaway `class G`.
+    """
+
+    def __init__(self, store, rank: int, size: int, name: str = "bw",
+                 timeout: float = 120.0):
+        self.store = store
+        self.timeout = timeout
+        self.group_name = name
+        self._rank = int(rank)
+        self._size = int(size)
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    def get_global_rank(self, r: int) -> int:
+        return r
+
+    def get_group_rank(self, r: int) -> int:
+        return r
+
+
 def persist_result(name: str, record: dict) -> None:
     """Merge one bench record into benchmarks/results.json.
 

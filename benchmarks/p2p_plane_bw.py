@@ -33,20 +33,12 @@ import numpy as np
 from pytorch_distributed_example_tpu import distributed as dist
 from pytorch_distributed_example_tpu.store import TCPStore, PrefixStore
 from pytorch_distributed_example_tpu.p2p import P2PPlane
+from benchmarks.common import BwStubGroup
 
 store = TCPStore("127.0.0.1", int(sys.argv[1]), timeout=120.0)
 mode = sys.argv[4]
 
-class G:
-    def __init__(self):
-        self.store, self.timeout = store, 120.0
-        self.group_name = "bw"
-    def rank(self): return 0
-    def size(self): return 2
-    def get_global_rank(self, r): return r
-    def get_group_rank(self, r): return r
-
-g = G()
+g = BwStubGroup(store, rank=0, size=2)
 if mode == "plane":
     dist._p2p_plane = P2PPlane(
         0, PrefixStore("p2pbw", store), advertise="127.0.0.1"
@@ -73,27 +65,12 @@ def run_mode(mode: str, sizes, iters: int, emit):
     from pytorch_distributed_example_tpu.p2p import P2PPlane
     from pytorch_distributed_example_tpu.store import PrefixStore, TCPStore
 
+    from benchmarks.common import BwStubGroup
+
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     store = TCPStore("127.0.0.1", 0, is_master=True, timeout=120.0)
 
-    class G:
-        def __init__(self):
-            self.store, self.timeout = store, 120.0
-            self.group_name = "bw"
-
-        def rank(self):
-            return 1
-
-        def size(self):
-            return 2
-
-        def get_global_rank(self, r):
-            return r
-
-        def get_group_rank(self, r):
-            return r
-
-    g = G()
+    g = BwStubGroup(store, rank=1, size=2)
     plane = None
     if mode == "plane":
         plane = P2PPlane(

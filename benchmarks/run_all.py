@@ -99,6 +99,23 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # topology-aware collective planner vs stock lowering
+            # (ISSUE 9): same dispatch A/B'd per size, algorithm chosen
+            # by the measured probe table; >= 1.3x target in at least
+            # one (size, world) regime
+            "allreduce_planner",
+            [sys.executable, "benchmarks/allreduce_bw.py", "--planner"]
+            + (
+                # quick: hermetic (no cache reads/writes), just the
+                # crossover buckets; full: the real artifact flow
+                ["--no-probe-cache", "--min-kb", "256", "--max-mb", "4",
+                 "--iters", "3", "--warmup", "1"]
+                if q
+                else ["--max-mb", "64"]
+            ),
+            {},
+        ),
+        (
             "resnet_ddp",
             [sys.executable, "benchmarks/resnet_ddp.py"]
             + (["--steps", "5", "--warmup", "2", "--batch", "32"] if q else []),

@@ -161,16 +161,35 @@ class ProcessGroup:
                 still.append((work, done))
         self._inflight = still
 
-    def _dispatch(self, op_name: str, array, fn, detail: str = ""):
+    def _dispatch(self, op_name: str, array, fn, detail: str = "",
+                  plan_args: Optional[Dict[str, Any]] = None):
         """Run one collective with full observability: sequence number,
         ProcessGroupStatus, FlightRecorder entry, watchdog registration,
         completion sweep. `detail` carries op parameters that must agree
         across ranks but are invisible in (op, shape, dtype) — the
         reduce op, broadcast source, permute pairs — so the schedule
         fingerprint (TDX_SCHEDULE_CHECK) catches e.g. rank 0 running
-        SUM while rank 1 runs MAX."""
+        SUM while rank 1 runs MAX.
+
+        `plan_args` marks the op plannable: when the topology-aware
+        collective planner is active for this group
+        (TDX_COLLECTIVE_PLANNER=1 or a per-group override), the stock
+        `fn` is swapped for the planner's probe-chosen schedule —
+        compiled ring/tree programs in driver mode, explicit p2p-plane
+        schedules in multiproc mode — transparently for every caller
+        (DDP, Reducer, ZeRO-2 all dispatch through here). The planner
+        declining (None) keeps `fn`; the op fingerprint is identical
+        either way, so mixed planner-on/off debugging stays comparable."""
         from .utils.flight_recorder import global_recorder
 
+        if plan_args is not None:
+            from . import plan as _plan_mod
+
+            alt = _plan_mod.maybe_lower(
+                self, op_name, array, plan_args, fallback=fn
+            )
+            if alt is not None:
+                fn = alt
         self._sweep_inflight()
         seq = self._backend.next_sequence_number()
         shape = tuple(getattr(array, "shape", ()))
@@ -770,6 +789,7 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bool =
         dt.array,
         lambda: g.backend_impl.allreduce(dt.array, op),
         detail=str(op),
+        plan_args={"reduce_op": op},
     )
     return _finish(dt, out, work, async_op)
 
@@ -809,7 +829,12 @@ def all_gather(tensor, group=None, async_op: bool = False) -> Union[DistTensor, 
     (the rank axis replaces torch's output tensor list)."""
     g = _resolve(group)
     dt = _as_dist(tensor, g)
-    out, work = g._dispatch("all_gather", dt.array, lambda: g.backend_impl.allgather(dt.array))
+    out, work = g._dispatch(
+        "all_gather",
+        dt.array,
+        lambda: g.backend_impl.allgather(dt.array),
+        plan_args={},
+    )
     res = DistTensor(out, g)
     return (res, work) if async_op else res
 
@@ -866,6 +891,7 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group=None, async_op: bo
         dt.array,
         lambda: g.backend_impl.reduce_scatter(dt.array, op),
         detail=str(op),
+        plan_args={"reduce_op": op},
     )
     res = DistTensor(out, g)
     return (res, work) if async_op else res

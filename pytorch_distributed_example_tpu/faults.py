@@ -49,6 +49,19 @@ Named injection points wired in this package:
                                                     fingerprint so the next
                                                     checkpoint reports a
                                                     divergence — schedule.py)
+    plan.probe                                     (collective planner: before
+                                                    each probe measurement of
+                                                    a candidate algorithm —
+                                                    plan/probe.py)
+    plan.step                                      (collective planner: before
+                                                    each synthesized schedule
+                                                    round executes on the p2p
+                                                    plane; action "corrupt"
+                                                    perturbs the firing rank's
+                                                    per-step fingerprint so
+                                                    the verifier names the
+                                                    first divergent planner
+                                                    step — plan/executor.py)
     agent.heartbeat                                (node-elastic heartbeats)
     checkpoint.write / checkpoint.finalize         (integrity layer)
     serve.admit / serve.step                       (serve engine: before each
@@ -151,6 +164,8 @@ KNOWN_POINTS = frozenset({
     "collective.dispatch",
     "comm.quantize",
     "schedule.mismatch",
+    "plan.probe",
+    "plan.step",
     "agent.heartbeat",
     "checkpoint.write",
     "checkpoint.finalize",

@@ -310,7 +310,15 @@ def make_ddp_train_step(
 
     if isinstance(optimizer, ZeroRedundancyOptimizer):
         optimizer = optimizer.optimizer
-    hook = comm_hook or comm_hooks.allreduce_hook
+    hook = comm_hook
+    if hook is None:
+        # planner-aware default: when the topology-aware collective
+        # planner is active for this group, the gradient reduction takes
+        # the probe table's per-bucket winner (ring / tree / one-shot
+        # pmean) inside the compiled step; otherwise the stock pmean
+        from ..plan import ddp_comm_hook
+
+        hook = ddp_comm_hook(g) or comm_hooks.allreduce_hook
     # Stateful hooks (PowerSGD: error feedback + warm-started Q) carry an
     # explicit state pytree through the step — torch mutates PowerSGDState
     # in place (`powerSGD_hook.py`); functional XLA threads it instead.

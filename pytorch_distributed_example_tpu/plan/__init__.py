@@ -1,0 +1,336 @@
+"""Topology-aware collective planner (ROADMAP item 4; PCCL arxiv
+2606.07019, "The Big Send-off" arxiv 2504.18658).
+
+Instead of one fixed lowering per collective, the planner synthesizes
+ring / recursive-halving-doubling / hierarchical schedules per
+`(op, payload-size bucket, group, topology)` and picks among them from
+MEASURED probes persisted in an on-disk cache keyed by topology. Two
+execution planes realize a chosen plan:
+
+* **driver (SPMD)** — the schedule compiles to one XLA program over the
+  group mesh (`driver.py`); `ProcessGroup._dispatch` swaps it in for the
+  stock backend lowering, and DDP's compiled train step inherits it
+  leaf-wise through `ddp_comm_hook`;
+* **multiproc p2p** — the schedule executes literally over the direct
+  TCP data plane (`executor.py` walking `p2p.py` send/recv/recv_any),
+  with every round fingerprinted through the schedule verifier and a
+  `plan.step` fault seam, so a mid-collective fault surfaces as a named
+  `ScheduleMismatchError` rather than a hang.
+
+Opt-in: `TDX_COLLECTIVE_PLANNER=1` globally, or per group via
+`enable_for_group(pg, True/False)` (the override wins over the env in
+both directions). The stock lowering stays a first-class probe
+candidate ("onepass"): where it measures fastest, the planner dispatches
+it unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from . import driver, executor, probe, schedules, topology
+from .planner import CollectivePlanner
+from .schedules import Plan, Round, Step
+from .topology import Topology
+
+__all__ = [
+    "CollectivePlanner", "Plan", "Round", "Step", "Topology",
+    "active_for_group", "enable_for_group", "planner_for_group",
+    "maybe_lower", "ddp_comm_hook", "reset_group",
+    "driver", "executor", "probe", "schedules", "topology",
+]
+
+_ENV = "TDX_COLLECTIVE_PLANNER"
+_PLANNABLE = ("all_reduce", "all_gather", "reduce_scatter")
+
+
+def active_for_group(group) -> bool:
+    ov = getattr(group, "planner_override", None)
+    if ov is not None:
+        return bool(ov)
+    return os.environ.get(_ENV, "0") == "1"
+
+
+def enable_for_group(group, enabled: Optional[bool]) -> None:
+    """Per-group override: True/False pins the planner on/off for this
+    group regardless of TDX_COLLECTIVE_PLANNER; None defers to the env."""
+    group.planner_override = enabled
+    if not enabled:
+        reset_group(group)
+
+
+def reset_group(group) -> None:
+    """Drop the group's cached planner (tests / topology changes)."""
+    group._collective_planner = None
+
+
+def planner_for_group(group) -> CollectivePlanner:
+    pl = getattr(group, "_collective_planner", None)
+    if pl is None:
+        topo = topology.detect(group)
+        from ..backends.xla import AXIS
+
+        pl = CollectivePlanner(
+            topo,
+            mesh=group.mesh.jax_mesh,
+            axis=AXIS,
+        )
+        group._collective_planner = pl
+    return pl
+
+
+def _backend_is_xla(group) -> bool:
+    from ..backends.xla import XlaBackend
+
+    return isinstance(group.backend_impl, XlaBackend)
+
+
+def maybe_lower(group, op_name: str, array, plan_args: dict, fallback=None):
+    """The `_dispatch` seam: return a zero-arg callable producing
+    `(out, work)` that runs the planner's chosen schedule, or None to
+    take the stock lowering (planner off, op unplannable, reduce op
+    outside the synthesized algebra, "onepass" won the probe, or the
+    transport is unavailable). ``fallback`` is the stock lowering
+    callable; the plane path keeps it for conditions only discoverable
+    under watchdog coverage (an opted-out peer endpoint)."""
+    if array is None or op_name not in _PLANNABLE:
+        return None
+    if not active_for_group(group) or group.size() < 2:
+        return None
+    if not _backend_is_xla(group):
+        return None
+    try:
+        reduce_kind = (
+            driver.reduce_kind_of(plan_args["reduce_op"])
+            if "reduce_op" in plan_args
+            else "sum"
+        )
+    except KeyError:
+        return None  # PRODUCT / bitwise / PREMUL: stock lowering only
+    from .. import distributed as dist
+
+    if dist._world.mode == "multiproc":
+        return _lower_plane(group, op_name, array, reduce_kind, fallback)
+    return _lower_driver(group, op_name, array, reduce_kind)
+
+
+# -- driver plane -----------------------------------------------------------
+
+
+def _lower_driver(group, op_name: str, array, reduce_kind: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map_fn
+    from ..backends.xla import AXIS
+    from ..types import ArrayWork, OpType
+
+    pl = planner_for_group(group)
+    W = group.size()
+    per_rank_bytes = max(array.nbytes // W, 1)
+    alg, _source = pl.choose(op_name, per_rank_bytes, reduce_kind, "driver")
+    if alg == "onepass":
+        return None  # the probe chose the stock lowering: dispatch it
+    # per-rank element count the plan covers (all_gather: block;
+    # reduce_scatter: per-chunk; all_reduce: flat payload)
+    shape = tuple(array.shape)
+    if op_name == "reduce_scatter":
+        nelems = int(np.prod(shape[2:], dtype=np.int64)) if len(shape) > 2 else 1
+    else:
+        nelems = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 else 1
+    plan = pl.plan_for(op_name, alg, nelems)
+    sched = getattr(group, "_sched", None)
+    cache = pl.__dict__.setdefault("_driver_progs", {})
+    key = (op_name, alg, shape, str(array.dtype), reduce_kind)
+    prog = cache.get(key)
+    if prog is None:
+        body = driver.body_for(op_name, alg, W, AXIS, reduce_kind)
+        prog = jax.jit(shard_map_fn(
+            body, mesh=pl.mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+        ))
+        cache[key] = prog
+
+    optype = {
+        "all_reduce": OpType.ALLREDUCE,
+        "all_gather": OpType.ALLGATHER,
+        "reduce_scatter": OpType.REDUCE_SCATTER,
+    }[op_name]
+
+    def fn():
+        if sched is not None:
+            # the plan's per-round step sequence enters the schedule
+            # fingerprint exactly as on the p2p plane (driver mode:
+            # world-1 structural agreement, fingerprint path only)
+            for i, rnd in enumerate(plan.rounds):
+                sched.record(
+                    i, f"plan.{op_name}.{alg}", (plan.nelems,),
+                    str(array.dtype), detail=rnd.descriptor(),
+                )
+        out = prog(array)
+        return out, ArrayWork(out, optype, f"plan:{alg}")
+
+    return fn
+
+
+def ddp_comm_hook(group):
+    """Planner-aware default gradient hook for the compiled DDP step, or
+    None when the planner is off for this group. Applied INSIDE the
+    compiled train step (the comm-hook seam), leaf-wise: each gradient
+    leaf takes the probe table's winner for its own size bucket, so one
+    step can mix one-shot pmean for biases with a ring schedule for the
+    big matmul gradients."""
+    if not active_for_group(group) or group.size() < 2:
+        return None
+    if not _backend_is_xla(group):
+        return None
+    from .. import distributed as dist
+
+    if dist._world.mode == "multiproc":
+        # the hook chooses (and may PROBE) per leaf at trace time from
+        # purely process-local state; in multi-controller mode two hosts
+        # with different probe caches would compile two different SPMD
+        # programs — a silent schedule divergence. The compiled-step
+        # planner is a driver-mode feature; multiproc gradients keep the
+        # stock pmean (the eager dispatch path stays planner-covered
+        # through the store-agreed plane choice).
+        return None
+    pl = planner_for_group(group)
+    W = group.size()
+
+    def hook(grads, axis_name):
+        import jax
+        from jax import lax
+
+        def one(leaf):
+            alg, _ = pl.choose(
+                "all_reduce", int(leaf.size) * leaf.dtype.itemsize, "avg",
+                "driver",
+            )
+            if alg == "onepass":
+                return lax.pmean(leaf, axis_name)
+            body = driver.body_for("all_reduce", alg, W, axis_name, "avg")
+            return body(leaf)
+
+        return jax.tree_util.tree_map(one, grads)
+
+    return hook
+
+
+# -- multiproc p2p plane ----------------------------------------------------
+
+
+def _agreed_plane_choice(group, me: int, op_name: str, per_rank_bytes: int,
+                         reduce_kind: str, pl) -> str:
+    """Gang-agreed algorithm for a plane collective. Each process may
+    hold a DIFFERENT probe cache (per-host disks), so a purely local
+    `choose()` could hand two ranks two different schedules — a
+    divergence the verifier would only catch after the fact. Group rank
+    0's choice is published through the (incarnation-scoped) group
+    store once per (op, bucket); everyone else adopts it."""
+    bucket = probe.bucket_bytes(per_rank_bytes)
+    agreed = pl.__dict__.setdefault("_agreed_plane", {})
+    hit = agreed.get((op_name, bucket))
+    if hit is not None:
+        return hit
+    alg, _source = pl.choose(op_name, per_rank_bytes, reduce_kind, "plane")
+    if group.store is not None and group.size() > 1:
+        from .. import distributed as dist
+
+        key = f"planalg/gen{dist._world.scope}/{op_name}/{bucket}"
+        if me == 0:
+            group.store.set(key, alg.encode())
+        else:
+            group.store.wait([key], group.timeout)
+            alg = group.store.get(key).decode()
+    agreed[(op_name, bucket)] = alg
+    return alg
+
+
+def _lower_plane(group, op_name: str, array, reduce_kind: str,
+                 fallback=None):
+    """Lower onto the direct p2p data plane.
+
+    Only non-blocking checks run here, at dispatch-decision time: every
+    STORE-BLOCKING step — endpoint resolution, topology inference, the
+    rank-0 choice agreement — happens inside the returned callable,
+    which `_dispatch` runs under watchdog coverage (a peer that never
+    published would otherwise stall this rank invisibly, the exact
+    blind spot pre-dispatch watchdog registration exists to close).
+    An opted-out peer endpoint (rank-agreed: every rank reads the same
+    store value) falls back to the stock lowering via ``fallback``."""
+    from .. import distributed as dist
+    from ..types import CompletedWork, OpType
+
+    plane = dist._p2p_plane
+    if plane is None or not plane.listening:
+        return None
+    me = group.rank()
+    if me < 0:
+        return None  # non-member constructed the group collectively
+    W = group.size()
+
+    optype = {
+        "all_reduce": OpType.ALLREDUCE,
+        "all_gather": OpType.ALLGATHER,
+        "reduce_scatter": OpType.REDUCE_SCATTER,
+    }[op_name]
+
+    def fn():
+        for r in range(W):
+            if r == me:
+                continue
+            ep = plane.endpoint_of(group.get_global_rank(r), group.timeout)
+            if ep is None:
+                if fallback is not None:
+                    return fallback()  # rank-agreed: peer opted out
+                raise RuntimeError(
+                    f"planner: rank {r} has no p2p listener and no stock "
+                    "fallback was provided"
+                )
+        pl = planner_for_group(group)
+        shards = sorted(
+            array.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        local = np.concatenate(
+            [np.asarray(s.data) for s in shards], axis=0
+        )[0]
+        alg = _agreed_plane_choice(
+            group, me, op_name, max(local.nbytes, 1), reduce_kind, pl
+        )
+        if op_name == "reduce_scatter":
+            nelems = int(local[0].size) if local.ndim >= 1 else 1
+        else:
+            nelems = int(local.size)
+        plan = pl.plan_for(op_name, alg, nelems)
+        ctr = getattr(group, "_plan_route_ctr", 0)
+        group._plan_route_ctr = ctr + 1
+        route = f"plan/{dist._world.scope}/{group.group_name}/{ctr}"
+        res = executor.execute(
+            plan, me, local, plane,
+            route=route,
+            reduce_kind="sum" if reduce_kind == "avg" else reduce_kind,
+            average=reduce_kind == "avg",
+            timeout=group.timeout,
+            verifier=getattr(group, "_sched", None),
+            to_global=group.get_global_rank,
+        )
+        if op_name == "all_reduce":
+            out_local = np.asarray(res, dtype=local.dtype).reshape(local.shape)
+        elif op_name == "all_gather":
+            # plan blocks are the flat per-rank payload; restore (W, *s)
+            out_local = np.asarray(res, dtype=local.dtype).reshape(
+                (W,) + local.shape
+            )
+        else:  # reduce_scatter: own chunk, shaped like one list entry
+            out_local = np.asarray(res, dtype=local.dtype).reshape(
+                local.shape[1:]
+            )
+        from ..tensor import DistTensor
+
+        out = DistTensor.from_process_local(out_local, group).array
+        return out, CompletedWork(out, optype)
+
+    return fn

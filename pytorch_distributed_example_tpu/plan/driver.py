@@ -1,0 +1,239 @@
+"""Driver-plane (XLA) realizations of the planner's algorithms.
+
+In driver (single-controller SPMD) mode there are no sockets to walk —
+the p2p primitive of the mesh is `lax.ppermute` and the ring primitives
+are XLA's own ring collectives. Each algorithm here is a shard_map-
+compatible LOCAL body (takes this shard's block, uses the group axis)
+so the same body serves two consumers:
+
+* `ProcessGroup._dispatch` lowering — wrapped in the backend's
+  rank-stacked (1, *s) convention and jit-compiled per
+  (op, alg, shape, dtype, reduce-op), mirroring `backends/xla.py`;
+* DDP's in-jit comm hook (`plan.ddp_comm_hook`) — applied leaf-wise
+  inside the compiled train step, so the compiled DDP/ZeRO paths
+  inherit the probe table's per-size choices without leaving the jit.
+
+Algorithm menu (probe candidates): "onepass" is the stock one-shot
+lowering (psum / all_gather / psum_scatter — what `backends/xla.py`
+emits today) and exists so the probe table can PICK the status quo when
+it wins; "ring" decomposes all-reduce into reduce-scatter + all-gather
+ring phases (XLA lowers both as rings; on hosts where the one-shot
+all-reduce materializes worse schedules this is the measured win);
+"rhd" is the recursive-halving/doubling tree built literally from
+ppermutes (latency-optimal round count, power-of-two worlds).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = [
+    "body_for", "supports", "driver_candidates", "reduce_kind_of",
+]
+
+_SUM_KINDS = ("sum", "avg")
+
+
+def reduce_kind_of(op) -> str:
+    """Canonical planner name for a ReduceOp; raises KeyError for ops the
+    planner does not synthesize (PRODUCT, bitwise, PREMUL_SUM) — callers
+    catch and fall back to the stock lowering."""
+    from ..types import ReduceOp
+
+    return {
+        ReduceOp.SUM: "sum",
+        ReduceOp.AVG: "avg",
+        ReduceOp.MAX: "max",
+        ReduceOp.MIN: "min",
+    }[op]
+
+
+def supports(op_name: str, algorithm: str, world: int,
+             reduce_kind: str = "sum") -> bool:
+    """Can this (op, algorithm) run on the driver plane at this world?"""
+    if world < 2:
+        return False
+    if op_name == "all_reduce":
+        if algorithm == "onepass":
+            return True
+        if algorithm == "ring":
+            return reduce_kind in _SUM_KINDS  # psum_scatter sums
+        if algorithm == "rhd":
+            return (world & (world - 1)) == 0
+        return False
+    if op_name == "all_gather":
+        return algorithm in ("onepass", "ring")
+    if op_name == "reduce_scatter":
+        if algorithm == "onepass":
+            return True
+        return algorithm == "ring"
+    return False
+
+
+def driver_candidates(op_name: str, world: int, reduce_kind: str = "sum"):
+    return tuple(
+        a for a in ("onepass", "ring", "rhd")
+        if supports(op_name, a, world, reduce_kind)
+    )
+
+
+def _combine(reduce_kind: str):
+    import jax.numpy as jnp
+
+    if reduce_kind in _SUM_KINDS:
+        return jnp.add
+    return {"max": jnp.maximum, "min": jnp.minimum}[reduce_kind]
+
+
+def _ring_pairs(world: int):
+    return [(i, (i + 1) % world) for i in range(world)]
+
+
+def body_for(op_name: str, algorithm: str, world: int, axis: str,
+             reduce_kind: str = "sum") -> Callable:
+    """shard_map-compatible local body. Conventions match
+    `backends/xla.py`: all_reduce takes/returns the local (1, *s) block;
+    all_gather (1, *s) -> (1, W, *s); reduce_scatter (1, W, *s) -> (1, *s).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = world
+    avg = reduce_kind == "avg"
+
+    if op_name == "all_reduce":
+        if algorithm == "onepass":
+            red = {
+                "sum": lambda t: lax.psum(t, axis),
+                "avg": lambda t: lax.pmean(t, axis),
+                "max": lambda t: lax.pmax(t, axis),
+                "min": lambda t: lax.pmin(t, axis),
+            }[reduce_kind]
+            return red
+
+        if algorithm == "ring":
+
+            def ring(t):  # (1, *s)
+                flat = t.reshape(-1)
+                n0 = flat.shape[0]
+                pad = (-n0) % W
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)]
+                    )
+                red = lax.psum_scatter(flat, axis, tiled=True)
+                out = lax.all_gather(red, axis, tiled=True)
+                if avg:
+                    out = out / W
+                return out[:n0].reshape(t.shape)
+
+            return ring
+
+        if algorithm == "rhd":
+            comb = _combine(reduce_kind)
+
+            def rhd(t):  # (1, *s)
+                flat = t.reshape(-1)
+                n0 = flat.shape[0]
+                pad = (-n0) % W
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)]
+                    )
+                n = flat.shape[0]
+                idx = lax.axis_index(axis)
+                cur = flat
+                seg = n
+                off = jnp.int32(0)
+                L = W.bit_length() - 1
+                for k in range(L):  # recursive halving (reduce-scatter)
+                    m = 1 << k
+                    pairs = [(i, i ^ m) for i in range(W)]
+                    half = seg // 2
+                    hi = (idx // m) % 2
+                    keep_off = off + jnp.where(hi == 1, half, 0)
+                    send_off = off + jnp.where(hi == 1, 0, half)
+                    got = lax.ppermute(
+                        lax.dynamic_slice(cur, (send_off,), (half,)),
+                        axis, pairs,
+                    )
+                    red = comb(
+                        lax.dynamic_slice(cur, (keep_off,), (half,)), got
+                    )
+                    cur = lax.dynamic_update_slice(cur, red, (keep_off,))
+                    off = keep_off
+                    seg = half
+                for k in reversed(range(L)):  # recursive doubling (gather)
+                    m = 1 << k
+                    pairs = [(i, i ^ m) for i in range(W)]
+                    hi = (idx // m) % 2
+                    peer_off = jnp.where(hi == 1, off - seg, off + seg)
+                    got = lax.ppermute(
+                        lax.dynamic_slice(cur, (off,), (seg,)), axis, pairs
+                    )
+                    cur = lax.dynamic_update_slice(cur, got, (peer_off,))
+                    off = jnp.minimum(off, peer_off)
+                    seg = seg * 2
+                if avg:
+                    cur = cur / W
+                return cur[:n0].reshape(t.shape)
+
+            return rhd
+
+    if op_name == "all_gather":
+        if algorithm == "onepass":
+            return lambda t: lax.all_gather(t[0], axis, axis=0,
+                                            tiled=False)[None]
+
+        def ag_ring(t):  # (1, *s) -> (1, W, *s)
+            x = t[0]
+            idx = lax.axis_index(axis)
+            out = jnp.zeros((W,) + x.shape, x.dtype)
+            out = lax.dynamic_update_slice(
+                out, x[None], (idx,) + (0,) * x.ndim
+            )
+            cur = x
+            for s in range(W - 1):
+                cur = lax.ppermute(cur, axis, _ring_pairs(W))
+                b = (idx - s - 1) % W
+                out = lax.dynamic_update_slice(
+                    out, cur[None], (b,) + (0,) * x.ndim
+                )
+            return out[None]
+
+        return ag_ring
+
+    if op_name == "reduce_scatter":
+        if algorithm == "onepass":
+
+            def rs_one(t):  # (1, W, *s) — the stock psum_scatter lowering
+                r = lax.psum_scatter(t[0], axis, scatter_dimension=0,
+                                     tiled=True)
+                if avg:
+                    r = r / W
+                return r
+
+            return rs_one
+
+        comb = _combine(reduce_kind)
+
+        def rs_ring(t):  # (1, W, *s) -> (1, *s)
+            xs = t[0].reshape(W, -1)
+            cs = xs.shape[1]
+            flat = xs.reshape(-1)
+            idx = lax.axis_index(axis)
+
+            def chunk(j):
+                return lax.dynamic_slice(flat, (j * cs,), (cs,))
+
+            cur = chunk((idx - 1) % W)
+            for s in range(W - 1):
+                nxt = lax.ppermute(cur, axis, _ring_pairs(W))
+                cur = comb(nxt, chunk((idx - s - 2) % W))
+            if avg:
+                cur = cur / W
+            return cur.reshape((1,) + t.shape[2:])
+
+        return rs_ring
+
+    raise ValueError(f"no driver body for {op_name}/{algorithm}")

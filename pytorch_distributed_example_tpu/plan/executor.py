@@ -1,0 +1,171 @@
+"""Execute a synthesized `Plan` over the direct p2p data plane.
+
+The executor walks the plan's rounds literally: for each round it fires
+the `plan.step` fault point, records the round's canonical descriptor
+into the schedule verifier (when one is armed), performs its sends, then
+its receives. That ordering is the chaos contract:
+
+* the fingerprint lands BEFORE any socket op, so a rank that dies inside
+  round k has already agreed on rounds 0..k — the survivors' NEXT
+  checkpoint (they record round k+1 before blocking in its recv) times
+  out on the dead rank and raises `ScheduleMismatchError` naming it and
+  its last recorded planner steps, instead of the survivors hanging in a
+  recv that can never complete;
+* an advisory `corrupt` rule at `plan.step` perturbs THIS rank's round
+  descriptor, so the next checkpoint reports the first divergent planner
+  step on EVERY rank (the injected-divergence drill for the planner
+  path, mirroring `schedule.mismatch` for the dispatch path).
+
+Reduction order is fixed by the plan (ring/tree order; `reduce_any`
+folds in sorted-peer order regardless of wire arrival), so re-executing
+the same plan on the same inputs is bitwise-identical — the whole-pass
+retry story.
+
+Routes: every execution must use a fresh `route` string (the caller
+scopes it by group, collective sequence number, and retry attempt);
+sequence numbers within the route are assigned by walking the plan, so
+both ends of every pair count identically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import faults
+from .schedules import Plan
+
+__all__ = ["execute", "combine_for"]
+
+
+def combine_for(reduce_kind: str) -> Callable:
+    """Elementwise fold for the plan's reduce steps. ``reduce_kind`` is
+    the planner's canonical name: "sum" (also serving AVG — the caller
+    divides at the end), "max", "min"."""
+    return {
+        "sum": np.add,
+        "max": np.maximum,
+        "min": np.minimum,
+    }[reduce_kind]
+
+
+def execute(
+    plan: Plan,
+    rank: int,
+    payload: np.ndarray,
+    plane,
+    *,
+    route: str,
+    reduce_kind: str = "sum",
+    average: bool = False,
+    timeout: float = 60.0,
+    verifier=None,
+    to_global: Optional[Callable[[int], int]] = None,
+) -> np.ndarray:
+    """Run ``plan`` as group-rank ``rank`` over ``plane``; returns this
+    rank's result (all_reduce: full payload; all_gather: (W, n) stack;
+    reduce_scatter: own chunk). ``payload`` is this rank's flat input
+    (all_reduce: (n,); all_gather: (n,); reduce_scatter: (W*cs,) chunk
+    list). ``to_global`` maps group ranks to the plane's global ranks
+    (identity when the group IS the world)."""
+    gmap = to_global if to_global is not None else (lambda r: r)
+    combine = combine_for(reduce_kind)
+    flat = np.ascontiguousarray(payload).reshape(-1)
+    dtype = flat.dtype
+
+    if plan.op == "all_gather":
+        buf = np.zeros(plan.world * plan.nelems, dtype)
+        if flat.size != plan.nelems:
+            raise ValueError(
+                f"all_gather payload {flat.size} != plan block {plan.nelems}"
+            )
+        buf[rank * plan.nelems:(rank + 1) * plan.nelems] = flat
+    else:
+        if flat.size > plan.nelems:
+            raise ValueError(
+                f"payload {flat.size} exceeds plan size {plan.nelems}"
+            )
+        buf = np.zeros(plan.nelems, dtype)
+        buf[: flat.size] = flat
+
+    send_seq: Dict[int, int] = {}
+    recv_seq: Dict[int, int] = {}
+
+    def next_send(peer: int) -> int:
+        s = send_seq.get(peer, 0)
+        send_seq[peer] = s + 1
+        return s
+
+    def next_recv(peer: int) -> int:
+        s = recv_seq.get(peer, 0)
+        recv_seq[peer] = s + 1
+        return s
+
+    step_seq = 0
+    for rnd in plan.rounds:
+        desc = rnd.descriptor()
+        # the fault seam fires before the fingerprint so an advisory
+        # corrupt rule can perturb what gets recorded; generic actions
+        # (error/hang/crash) fire here too — before any socket op of
+        # this round, after full agreement on every earlier round
+        rule = faults.fire(
+            "plan.step", rank=rank, phase=rnd.phase, index=rnd.index,
+            algorithm=plan.algorithm,
+        )
+        if rule is not None and rule.action == "corrupt":
+            desc += "|<injected-divergence>"
+        if verifier is not None:
+            verifier.record(
+                step_seq, f"plan.{plan.op}.{plan.algorithm}",
+                (plan.nelems,), str(dtype), detail=desc,
+            )
+        step_seq += 1
+        my = rnd.steps[rank]
+        for s in my:
+            if s.kind == "send":
+                plane.send(
+                    gmap(s.peer), route, 0, next_send(s.peer),
+                    buf[s.offset:s.offset + s.length], timeout,
+                )
+        for s in my:
+            if s.kind in ("copy", "reduce"):
+                val = plane.recv(
+                    gmap(s.peer), route, 0, next_recv(s.peer), timeout
+                )
+                seg = buf[s.offset:s.offset + s.length]
+                if s.kind == "copy":
+                    seg[...] = val
+                else:
+                    combine(seg, val.astype(dtype, copy=False), out=seg)
+            elif s.kind == "reduce_any":
+                # take contributions off the wire in arrival order
+                # (latency), fold them in sorted-peer order (bitwise
+                # determinism across retries)
+                pending = {p: next_recv(p) for p in s.peers}
+                got: Dict[int, np.ndarray] = {}
+                while pending:
+                    cands = [(gmap(p), q) for p, q in pending.items()]
+                    src_g, val = plane.recv_any(cands, route, 0, timeout)
+                    src = next(
+                        p for p in pending if gmap(p) == src_g
+                    )
+                    got[src] = np.asarray(val)
+                    del pending[src]
+                seg = buf[s.offset:s.offset + s.length]
+                for p in sorted(got):
+                    combine(seg, got[p].astype(dtype, copy=False), out=seg)
+
+    if plan.op == "all_reduce":
+        out = buf[: flat.size]
+        if average:
+            out = out / plan.world
+        return out
+    if plan.op == "all_gather":
+        return buf.reshape(plan.world, plan.nelems)
+    # reduce_scatter: own chunk
+    cs = plan.nelems // plan.world
+    out = buf[rank * cs:(rank + 1) * cs]
+    if average:
+        out = out / plan.world
+    return out

@@ -372,3 +372,117 @@ def test_tag_seq_range_validation(planes):
         a.send(1, "rng", 2**31, 0, np.array([1.0]), 5.0)
     with pytest.raises(ValueError, match="int64"):
         a.send(1, "rng", 0, 2**63, np.array([1.0]), 5.0)
+
+
+# -- recv_any / endpoint_of edges the planner's hierarchical schedules
+# -- lean on (ISSUE 9 satellite): timeouts and tombstones must stay
+# -- correct while unrelated multi-peer traffic is in flight
+
+
+def test_recv_any_timeout_under_concurrent_traffic(planes):
+    """recv_any waiting on a (route, tag) nobody sends must time out
+    within its budget even while OTHER tags from several peers stream
+    through the same inbox — and none of that traffic is lost."""
+    a, b, c = planes(0), planes(1), planes(2)
+    stop = threading.Event()
+    sent = {1: 0, 2: 0}
+
+    def chatter(plane, src):
+        i = 0
+        while not stop.is_set():
+            plane.send(0, "noise", 5, i, np.full(256, float(src)), 10.0)
+            sent[src] = i + 1
+            i += 1
+            time.sleep(0.005)
+
+    ts = [
+        threading.Thread(target=chatter, args=(p, r), daemon=True)
+        for p, r in ((b, 1), (c, 2))
+    ]
+    for t in ts:
+        t.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="nothing from"):
+            a.recv_any([(1, 0), (2, 0)], "wanted", 9, 0.5)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(10)
+    # the concurrent noise was buffered, not dropped: drain it all
+    for src in (1, 2):
+        for i in range(sent[src]):
+            got = a.recv(src, "noise", 5, i, 10.0)
+            assert got[0] == float(src)
+
+
+def test_recv_any_multi_peer_storm_no_loss_no_dupes(planes):
+    """Concurrent senders on the SAME (route, tag): a recv_any loop with
+    per-peer next-expected sequences must deliver every message exactly
+    once (the hierarchical leader's intra-host reduce pattern)."""
+    a = planes(0)
+    peers = [planes(r) for r in (1, 2, 3)]
+    n_msgs = 25
+
+    def sender(plane, src):
+        for i in range(n_msgs):
+            plane.send(0, "storm", 0, i, np.array([src * 1000 + i]), 15.0)
+
+    ts = [
+        threading.Thread(target=sender, args=(p, r + 1))
+        for r, p in enumerate(peers)
+    ]
+    for t in ts:
+        t.start()
+    next_seq = {1: 0, 2: 0, 3: 0}
+    got = {1: [], 2: [], 3: []}
+    for _ in range(3 * n_msgs):
+        cands = [
+            (src, seq) for src, seq in next_seq.items() if seq < n_msgs
+        ]
+        src, val = a.recv_any(cands, "storm", 0, 15.0)
+        assert int(val[0]) == src * 1000 + next_seq[src]
+        got[src].append(int(val[0]) - src * 1000)
+        next_seq[src] += 1
+    for t in ts:
+        t.join(10)
+    for src in (1, 2, 3):
+        assert got[src] == list(range(n_msgs))  # in order, no dupes/loss
+
+
+def test_endpoint_of_timeout_when_never_published(planes):
+    """endpoint_of blocks on the store key; a rank that never publishes
+    (not part of the gang) must surface as a bounded timeout, not a
+    hang — the planner declines to plan over missing endpoints."""
+    a = planes(0)
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as ei:
+        a.endpoint_of(7, 0.4)
+    assert time.monotonic() - t0 < 5.0
+    assert not isinstance(ei.value, AssertionError)
+
+
+def test_endpoint_tombstone_read_as_opted_out(planes):
+    """close() compare_sets the endpoint to the tombstone: a reader with
+    no warm cache sees 'opted out' (None) — exactly the store-fallback
+    signal — while a reader that cached the live endpoint keeps it (the
+    documented per-incarnation contract)."""
+    st = HashStore(30.0)
+    a = P2PPlane(0, st, advertise="127.0.0.1").start()
+    b = P2PPlane(1, st, advertise="127.0.0.1").start()
+    cached = b.endpoint_of(0, 5.0)
+    assert cached is not None
+    a.close()
+    # warm cache: unchanged (send would fail fatally — gloo semantics)
+    assert b.endpoint_of(0, 5.0) == cached
+    # cold reader: tombstone reads as "opted out", so it takes the
+    # store path instead of dialing a dead listener
+    c = P2PPlane(2, st, advertise="127.0.0.1").start()
+    try:
+        assert c.endpoint_of(0, 5.0) is None
+        with pytest.raises(RuntimeError, match="no p2p listener"):
+            c.send(0, "r", 0, 0, np.array([1.0]), 5.0)
+    finally:
+        b.close()
+        c.close()

@@ -1,0 +1,826 @@
+"""Topology-aware collective planner (ISSUE 9, `plan/`).
+
+Covers: schedule synthesis correctness (ring / recursive-halving-
+doubling / hierarchical, executed literally over in-process p2p planes),
+deterministic schedule artifacts, probe-cache persistence + hygiene
+(topology-mismatch warn-once, disable escape hatch), probe-driven
+algorithm choice, the `_dispatch` lowering (driver plane, parity vs the
+stock lowering incl. BITWISE equality at the 2-rank bench geometry),
+DDP's planner comm hook, and the `plan.step` chaos contract: a fault
+mid-planner-collective surfaces as `ScheduleMismatchError` naming the
+first divergent planner step on every surviving rank — no hang — and a
+whole-pass retry replays bitwise.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu import faults, plan
+from pytorch_distributed_example_tpu.plan import executor, probe, schedules
+from pytorch_distributed_example_tpu.plan.planner import CollectivePlanner
+from pytorch_distributed_example_tpu.plan.topology import Topology
+from pytorch_distributed_example_tpu.p2p import P2PPlane
+from pytorch_distributed_example_tpu.schedule import (
+    ScheduleMismatchError,
+    ScheduleVerifier,
+)
+from pytorch_distributed_example_tpu.store import HashStore, PrefixStore
+from pytorch_distributed_example_tpu.types import DistError, ReduceOp
+
+
+@pytest.fixture(autouse=True)
+def _isolated_probe_cache(tmp_path, monkeypatch):
+    """Never read or write the user-level probe cache from tests."""
+    monkeypatch.setenv(
+        "TDX_PLANNER_PROBE_CACHE", str(tmp_path / "probe_cache.json")
+    )
+    monkeypatch.delenv("TDX_PLANNER_FORCE", raising=False)
+    monkeypatch.delenv("TDX_COLLECTIVE_PLANNER", raising=False)
+    monkeypatch.delenv("TDX_TOPOLOGY", raising=False)
+    yield
+
+
+def _topo(W, hosts=None):
+    return Topology(W, hosts or (tuple(range(W)),), "cpu")
+
+
+def _run_gang(pln, inputs, reduce_kind="sum", average=False,
+              verifiers=None, route="t", join_timeout=60.0):
+    """Execute a plan across W in-process planes (one thread per rank);
+    returns (results, errors) keyed by rank."""
+    W = pln.world
+    st = HashStore(30.0)
+    planes = [
+        P2PPlane(r, st, advertise="127.0.0.1").start() for r in range(W)
+    ]
+    results, errors = [None] * W, [None] * W
+
+    def worker(r):
+        try:
+            results[r] = executor.execute(
+                pln, r, inputs[r], planes[r], route=route, timeout=15.0,
+                reduce_kind=reduce_kind, average=average,
+                verifier=verifiers[r] if verifiers else None,
+            )
+        except Exception as e:  # collected for assertions, incl. chaos
+            errors[r] = e
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(W)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_timeout)
+    alive = [t for t in ts if t.is_alive()]
+    for p in planes:
+        p.close()
+    assert not alive, "planner gang hung (threads still alive)"
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+
+class TestTopology:
+    def test_from_env_grouping_and_key(self, monkeypatch):
+        from pytorch_distributed_example_tpu.plan import topology as topo_mod
+
+        monkeypatch.setenv("TDX_TOPOLOGY", "a,a,b,b")
+        t = topo_mod.from_env(4, "cpu")
+        assert t.hosts == ((0, 1), (2, 3)) and t.multi_host
+        assert t.key() == "w4/h2x2/cpu"
+        assert t.leaders() == [0, 2]
+        assert t.host_of(3) == 1
+
+    def test_from_env_wrong_length_raises(self, monkeypatch):
+        from pytorch_distributed_example_tpu.plan import topology as topo_mod
+
+        monkeypatch.setenv("TDX_TOPOLOGY", "0,1")
+        with pytest.raises(ValueError, match="names 2 ranks"):
+            topo_mod.from_env(3)
+
+    def test_partition_validated(self):
+        with pytest.raises(ValueError, match="partition"):
+            Topology(3, ((0, 1),))
+
+    def test_detect_driver_mode_single_host(self, world):
+        t = plan.topology.detect(world)
+        assert t.world == world.size()
+        assert not t.multi_host  # all virtual CPU devices in one process
+
+    def test_detect_ignores_env_override_sized_for_another_gang(
+        self, world, monkeypatch
+    ):
+        """A world-sized TDX_TOPOLOGY pin must not fail SUBGROUP
+        collectives: detect() falls back to inference when the override
+        names a different rank count (mirror of the TDX_PLANNER_FORCE
+        fallback hardening)."""
+        monkeypatch.setenv(
+            "TDX_TOPOLOGY", ",".join("0" for _ in range(world.size()))
+        )
+        sub = tdx.new_group([0, 1], group_desc="topo_sub_pair")
+        try:
+            t = plan.topology.detect(sub)
+            assert t.world == 2  # inferred, override ignored
+        finally:
+            tdx.distributed.destroy_process_group(sub)
+
+    def test_same_shape_different_membership_share_key(self):
+        a = Topology(4, ((0, 1), (2, 3)), "cpu")
+        b = Topology(4, ((0, 2), (1, 3)), "cpu")
+        assert a.key() == b.key()
+        assert a.key() != Topology(4, ((0,), (1, 2, 3)), "cpu").key()
+
+
+# ---------------------------------------------------------------------------
+# schedule synthesis + artifact
+# ---------------------------------------------------------------------------
+
+
+class TestSchedules:
+    def test_round_counts(self):
+        t = _topo(4)
+        assert len(schedules.synthesize("all_reduce", "ring", 4, 8, t).rounds) == 6
+        assert len(schedules.synthesize("all_reduce", "rhd", 4, 8, t).rounds) == 4
+        th = Topology(4, ((0, 1), (2, 3)), "cpu")
+        hier = schedules.synthesize("all_reduce", "hier", 4, 8, th)
+        # intra_reduce + leader-ring (2 leaders: 1 rs + 1 ag) + intra_bcast
+        assert [r.phase for r in hier.rounds] == [
+            "intra_reduce", "xhost_rs", "xhost_ag", "intra_bcast",
+        ]
+
+    def test_rhd_requires_pow2(self):
+        with pytest.raises(AssertionError):
+            schedules.synthesize("all_reduce", "rhd", 3, 6, _topo(3))
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(ValueError, match="unknown all_reduce"):
+            schedules.synthesize("all_reduce", "warp", 2, 4, _topo(2))
+        with pytest.raises(ValueError, match="unplannable"):
+            schedules.synthesize("broadcast", "ring", 2, 4, _topo(2))
+
+    def test_padding_recorded(self):
+        p = schedules.synthesize("all_reduce", "ring", 4, 37, _topo(4))
+        assert p.nelems == 40 and p.pad == 3
+
+    def test_artifact_deterministic(self):
+        a = schedules.synthesize("all_reduce", "ring", 4, 16, _topo(4))
+        b = schedules.synthesize("all_reduce", "ring", 4, 16, _topo(4))
+        assert a.artifact() == b.artifact()
+        assert a.fingerprint() == b.fingerprint()
+        # artifact is JSON-stable and names every rank's steps per round
+        doc = json.loads(json.dumps(a.artifact(), sort_keys=True))
+        assert doc["algorithm"] == "ring" and len(doc["rounds"]) == 6
+        assert all(len(r["steps"]) == 4 for r in doc["rounds"])
+
+    def test_round_descriptor_is_rank_agnostic(self):
+        p = schedules.synthesize("all_reduce", "rhd", 4, 16, _topo(4))
+        # one descriptor string per round, regardless of which rank asks
+        for rnd in p.rounds:
+            assert rnd.descriptor() == rnd.descriptor()
+            assert rnd.phase in rnd.descriptor()
+
+    def test_artifact_emission_to_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TDX_PLANNER_ARTIFACT_DIR", str(tmp_path / "art"))
+        pl = CollectivePlanner(_topo(4), probe_fn=lambda *a: {"ring": 1.0})
+        p = pl.plan_for("all_reduce", "ring", 16)
+        files = list((tmp_path / "art").glob("*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["algorithm"] == "ring"
+        assert p.fingerprint()[:12] in files[0].name
+
+
+# ---------------------------------------------------------------------------
+# executor over real in-process p2p planes
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorGangs:
+    @pytest.mark.parametrize("alg,W,hosts", [
+        ("ring", 3, None),
+        ("ring", 4, None),
+        ("rhd", 4, None),
+        ("hier", 5, ((0, 1, 2), (3, 4))),
+        ("hier", 3, None),  # single host: leader star
+    ])
+    def test_all_reduce_matches_numpy(self, alg, W, hosts):
+        t = _topo(W, hosts)
+        n = 37  # exercises ring/rhd padding
+        rng = np.random.default_rng(1)
+        xs = [rng.standard_normal(n).astype(np.float32) for _ in range(W)]
+        ref = np.sum(np.stack(xs).astype(np.float64), axis=0)
+        p = schedules.synthesize("all_reduce", alg, W, n, t)
+        res, errs = _run_gang(p, xs)
+        assert not any(errs), errs
+        for r in range(W):
+            np.testing.assert_allclose(res[r], ref, rtol=1e-5, atol=1e-5)
+
+    def test_all_gather_and_reduce_scatter(self):
+        W, n = 4, 6
+        rng = np.random.default_rng(2)
+        xs = [rng.standard_normal(n).astype(np.float32) for _ in range(W)]
+        p = schedules.synthesize("all_gather", "ring", W, n, _topo(W))
+        res, errs = _run_gang(p, xs)
+        assert not any(errs), errs
+        for r in range(W):
+            np.testing.assert_array_equal(res[r], np.stack(xs))
+        lists = [
+            rng.standard_normal((W, 5)).astype(np.float32) for _ in range(W)
+        ]
+        ref = np.sum(np.stack(lists).astype(np.float64), axis=0)
+        p = schedules.synthesize("reduce_scatter", "ring", W, 5, _topo(W))
+        res, errs = _run_gang(p, lists)
+        assert not any(errs), errs
+        for r in range(W):
+            np.testing.assert_allclose(res[r], ref[r], rtol=1e-5, atol=1e-5)
+
+    def test_max_and_avg_kinds(self):
+        W, n = 3, 12
+        rng = np.random.default_rng(3)
+        xs = [rng.standard_normal(n).astype(np.float32) for _ in range(W)]
+        p = schedules.synthesize("all_reduce", "ring", W, n, _topo(W))
+        res, errs = _run_gang(p, xs, reduce_kind="max")
+        assert not any(errs), errs
+        np.testing.assert_array_equal(res[0], np.max(np.stack(xs), axis=0))
+        res, errs = _run_gang(p, xs, average=True)
+        assert not any(errs), errs
+        np.testing.assert_allclose(
+            res[1], np.mean(np.stack(xs).astype(np.float64), axis=0),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_hier_reduce_any_is_bitwise_deterministic(self):
+        """Leader folds member contributions in sorted-peer order even
+        though they arrive off the wire in any order: two executions of
+        the same plan produce identical BYTES on every rank."""
+        W = 5
+        t = Topology(W, ((0, 1, 2), (3, 4)), "cpu")
+        rng = np.random.default_rng(4)
+        xs = [rng.standard_normal(64).astype(np.float32) for _ in range(W)]
+        p = schedules.synthesize("all_reduce", "hier", W, 64, t)
+        a, ea = _run_gang(p, xs)
+        b, eb = _run_gang(p, xs)
+        assert not any(ea) and not any(eb)
+        for r in range(W):
+            assert a[r].tobytes() == b[r].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# probe cache
+# ---------------------------------------------------------------------------
+
+
+class TestProbeCache:
+    def test_bucket_ladder(self):
+        assert probe.bucket_bytes(1) == 1024
+        assert probe.bucket_bytes(1024) == 1024
+        assert probe.bucket_bytes(1025) == 4096
+        assert probe.bucket_bytes(1 << 20) == 1 << 20
+        assert probe.bucket_bytes((1 << 20) + 1) == 1 << 22
+
+    def test_roundtrip_and_merge(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        c = probe.ProbeCache(path)
+        c.update("w4/h4/cpu", "all_reduce", 4096, {"ring": 0.5, "onepass": 1.0})
+        c2 = probe.ProbeCache(path)
+        assert c2.lookup("w4/h4/cpu", "all_reduce", 4096) == {
+            "ring": 0.5, "onepass": 1.0,
+        }
+        # merge-on-write keeps foreign topology rows
+        c3 = probe.ProbeCache(path)
+        c3.update("w8/h8/cpu", "all_reduce", 4096, {"rhd": 0.1})
+        c4 = probe.ProbeCache(path)
+        assert c4.lookup("w4/h4/cpu", "all_reduce", 4096) is not None
+        assert c4.lookup("w8/h8/cpu", "all_reduce", 4096) == {"rhd": 0.1}
+
+    def test_topology_mismatch_warns_once(self, tmp_path, caplog):
+        path = str(tmp_path / "cache.json")
+        probe.ProbeCache(path).update(
+            "w2/h2/cpu", "all_reduce", 4096, {"ring": 0.5}
+        )
+        c = probe.ProbeCache(path)
+        with caplog.at_level(logging.WARNING):
+            assert c.lookup("w8/h8/tpu", "all_reduce", 4096) is None
+            assert c.lookup("w8/h8/tpu", "all_reduce", 1 << 20) is None
+        warns = [
+            r for r in caplog.records
+            if "do not apply to this topology" in r.getMessage()
+        ]
+        assert len(warns) == 1  # warn-once per process
+        assert "w8/h8/tpu" in warns[0].getMessage()
+
+    def test_cache_invalidation_on_corrupt_file(self, tmp_path, caplog):
+        path = tmp_path / "cache.json"
+        path.write_text("{not json")
+        c = probe.ProbeCache(str(path))
+        with caplog.at_level(logging.WARNING):
+            assert c.lookup("w4/h4/cpu", "all_reduce", 4096) is None
+        assert any("unreadable" in r.getMessage() for r in caplog.records)
+        # a fresh probe result replaces the corrupt file cleanly
+        c.update("w4/h4/cpu", "all_reduce", 4096, {"ring": 0.2})
+        assert probe.ProbeCache(str(path)).lookup(
+            "w4/h4/cpu", "all_reduce", 4096
+        ) == {"ring": 0.2}
+
+    def test_env_empty_disables_persistence(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TDX_PLANNER_PROBE_CACHE", "")
+        assert probe.cache_path() is None
+        c = probe.ProbeCache()
+        c.update("w4/h4/cpu", "all_reduce", 4096, {"ring": 0.1})
+        # in-memory table works; nothing written anywhere
+        assert c.lookup("w4/h4/cpu", "all_reduce", 4096) == {"ring": 0.1}
+        assert probe.ProbeCache().lookup(
+            "w4/h4/cpu", "all_reduce", 4096
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# planner choice
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerChoice:
+    def test_probe_argmin_and_disk_persistence(self, tmp_path):
+        calls = []
+
+        def fake_probe(op, cands, bucket, kind):
+            calls.append((op, tuple(cands), bucket))
+            return {"onepass": 3.0, "ring": 1.0, "rhd": 2.0}
+
+        path = str(tmp_path / "c.json")
+        pl = CollectivePlanner(
+            _topo(4), cache=probe.ProbeCache(path), probe_fn=fake_probe
+        )
+        alg, source = pl.choose("all_reduce", 4096)
+        assert (alg, source) == ("ring", "probe")
+        assert len(calls) == 1
+        # memoized in-process (4000 B shares the 4 KB bucket)
+        assert pl.choose("all_reduce", 4000) == ("ring", "probe")
+        assert len(calls) == 1
+        # a NEW planner on the same topology reads the disk table
+        pl2 = CollectivePlanner(
+            _topo(4), cache=probe.ProbeCache(path),
+            probe_fn=lambda *a: pytest.fail("should hit the cache"),
+        )
+        assert pl2.choose("all_reduce", 4096) == ("ring", "cache")
+
+    def test_force_env_pins_and_validates(self, monkeypatch):
+        pl = CollectivePlanner(
+            _topo(4), probe_fn=lambda *a: {"ring": 1.0, "onepass": 0.1,
+                                           "rhd": 0.5}
+        )
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "rhd")
+        assert pl.choose("all_reduce", 4096) == ("rhd", "force")
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "warp9")
+        with pytest.raises(ValueError, match="TDX_PLANNER_FORCE"):
+            pl.choose("all_reduce", 4096)
+        # a KNOWN algorithm that cannot carry this op falls back to the
+        # normal choice instead of failing the collective (a global
+        # ring pin must not break DDP's all_reduce(MIN) verification)
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        alg, source = pl.choose("all_reduce", 4096, "max")
+        assert alg in ("onepass", "rhd") and source != "force"
+
+    def test_structural_default_without_prober(self):
+        # p2p plane on a multi-host topology, no way to probe: hier
+        pl = CollectivePlanner(Topology(4, ((0, 1), (2, 3)), "cpu"))
+        pl.cache = probe.ProbeCache(path=None)
+        alg, source = pl.choose("all_reduce", 1 << 20, "sum", "plane")
+        assert (alg, source) == ("hier", "default")
+
+    def test_candidate_filters(self):
+        pl = CollectivePlanner(_topo(3), probe_fn=lambda *a: {})
+        # non-pow2: no rhd anywhere
+        assert "rhd" not in pl.candidates("all_reduce")
+        assert "rhd" not in pl.candidates("all_reduce", plane="plane")
+        # MAX cannot ride psum_scatter on the driver plane
+        pl8 = CollectivePlanner(_topo(8), probe_fn=lambda *a: {})
+        assert "ring" not in pl8.candidates("all_reduce", "max")
+        assert "rhd" in pl8.candidates("all_reduce", "max")
+        # single-host plane drops hier
+        assert "hier" not in pl8.candidates("all_reduce", plane="plane")
+        multi = CollectivePlanner(
+            Topology(8, (tuple(range(4)), tuple(range(4, 8))), "cpu"),
+            probe_fn=lambda *a: {},
+        )
+        assert "hier" in multi.candidates("all_reduce", plane="plane")
+
+
+# ---------------------------------------------------------------------------
+# _dispatch lowering (driver plane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def planner_on(world, monkeypatch):
+    """Enable the planner on the session world group; restore after."""
+    plan.enable_for_group(world, True)
+    yield world
+    plan.enable_for_group(world, None)  # defer back to the env
+    plan.reset_group(world)
+
+
+class TestDispatchLowering:
+    def _vals(self, world, n=257, seed=7):
+        rng = np.random.default_rng(seed)
+        return np.stack(
+            [rng.standard_normal(n).astype(np.float32)
+             for _ in range(world.size())]
+        )
+
+    def test_all_reduce_ring_matches_stock(self, planner_on, monkeypatch):
+        vals = self._vals(planner_on)
+        dt = tdx.DistTensor.from_stacked(vals.copy())
+        tdx.all_reduce(dt)  # planner on, but unforced choice may be stock
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        dt_ring = tdx.DistTensor.from_stacked(vals.copy())
+        tdx.all_reduce(dt_ring)
+        pl = plan.planner_for_group(planner_on)
+        assert pl.last_choice == ("all_reduce", "ring", "force")
+        plan.enable_for_group(planner_on, False)
+        dt_stock = tdx.DistTensor.from_stacked(vals.copy())
+        tdx.all_reduce(dt_stock)
+        plan.enable_for_group(planner_on, True)
+        np.testing.assert_allclose(
+            dt_ring.numpy(), dt_stock.numpy(), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            dt.numpy(), dt_stock.numpy(), rtol=1e-4, atol=1e-5
+        )
+
+    def test_all_reduce_rhd_and_avg(self, planner_on, monkeypatch):
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "rhd")
+        vals = self._vals(planner_on, n=100)
+        dt = tdx.DistTensor.from_stacked(vals.copy())
+        tdx.all_reduce(dt, ReduceOp.AVG)
+        np.testing.assert_allclose(
+            dt.numpy()[0], np.mean(vals.astype(np.float64), axis=0),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_all_gather_and_reduce_scatter_parity(self, planner_on,
+                                                  monkeypatch):
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        W = planner_on.size()
+        vals = self._vals(planner_on, n=33)
+        got = tdx.all_gather(tdx.DistTensor.from_stacked(vals.copy()))
+        plan.enable_for_group(planner_on, False)
+        ref = tdx.all_gather(tdx.DistTensor.from_stacked(vals.copy()))
+        plan.enable_for_group(planner_on, True)
+        np.testing.assert_array_equal(got.numpy(), ref.numpy())
+
+        rng = np.random.default_rng(9)
+        rows = np.stack(
+            [rng.standard_normal((W, 11)).astype(np.float32)
+             for _ in range(W)]
+        )
+        rs = tdx.reduce_scatter(tdx.DistTensor.from_stacked(rows.copy()))
+        plan.enable_for_group(planner_on, False)
+        rs_ref = tdx.reduce_scatter(tdx.DistTensor.from_stacked(rows.copy()))
+        plan.enable_for_group(planner_on, True)
+        np.testing.assert_allclose(
+            rs.numpy(), rs_ref.numpy(), rtol=1e-4, atol=1e-5
+        )
+
+    def test_unsupported_reduce_op_falls_back(self, planner_on):
+        vals = np.abs(self._vals(planner_on, n=9)) + 0.5
+        dt = tdx.DistTensor.from_stacked(vals.copy())
+        tdx.all_reduce(dt, ReduceOp.PRODUCT)  # stock path, no planner
+        np.testing.assert_allclose(
+            dt.numpy()[0],
+            np.prod(vals.astype(np.float64), axis=0),
+            rtol=1e-4,
+        )
+
+    def test_group_override_beats_env(self, world, monkeypatch):
+        monkeypatch.setenv("TDX_COLLECTIVE_PLANNER", "1")
+        assert plan.active_for_group(world)
+        plan.enable_for_group(world, False)
+        assert not plan.active_for_group(world)
+        plan.enable_for_group(world, None)
+        assert plan.active_for_group(world)
+        monkeypatch.delenv("TDX_COLLECTIVE_PLANNER")
+        assert not plan.active_for_group(world)
+
+    def test_bitwise_exact_at_two_rank_geometry(self, world, monkeypatch):
+        """At the headline bench geometry (2 ranks) every synthesized
+        sum reduces exactly two operands per element, so the planner
+        path is BIT-IDENTICAL to the stock psum — the loss-exactness
+        claim for the DDP trainer rests on this."""
+        sub = tdx.new_group([0, 1], group_desc="planner_pair")
+        rng = np.random.default_rng(11)
+        vals = np.stack(
+            [rng.standard_normal(301).astype(np.float32) for _ in range(2)]
+        )
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        plan.enable_for_group(sub, True)
+        try:
+            dt_ring = tdx.DistTensor.from_stacked(vals.copy(), sub)
+            tdx.all_reduce(dt_ring, group=sub)
+            ring_bytes = np.asarray(dt_ring.numpy()).tobytes()
+            plan.enable_for_group(sub, False)
+            dt_stock = tdx.DistTensor.from_stacked(vals.copy(), sub)
+            tdx.all_reduce(dt_stock, group=sub)
+            assert np.asarray(dt_stock.numpy()).tobytes() == ring_bytes
+        finally:
+            plan.enable_for_group(sub, False)
+            tdx.distributed.destroy_process_group(sub)
+
+
+# ---------------------------------------------------------------------------
+# DDP comm hook
+# ---------------------------------------------------------------------------
+
+
+class TestDDPCommHook:
+    def test_hook_none_when_inactive(self, world):
+        assert plan.ddp_comm_hook(world) is None
+
+    def test_hook_declines_in_multiproc_mode(self, world, monkeypatch):
+        """The in-jit hook chooses from process-LOCAL probe state; in
+        multi-controller mode that could compile divergent SPMD programs
+        across hosts — it must decline there (gradients keep pmean; the
+        eager dispatch path stays covered via the store-agreed choice)."""
+        plan.enable_for_group(world, True)
+        try:
+            assert plan.ddp_comm_hook(world) is not None
+            monkeypatch.setattr(
+                tdx.distributed._world, "mode", "multiproc"
+            )
+            assert plan.ddp_comm_hook(world) is None
+        finally:
+            plan.enable_for_group(world, None)
+            plan.reset_group(world)
+
+    def test_planner_hook_loss_exact_on_trainer(self, world, monkeypatch):
+        """Compiled DDP trainer at the 2-rank geometry: the planner's
+        in-jit hook (forced ring) must be loss- and param-BITWISE-exact
+        vs the stock pmean hook over several steps."""
+        import optax
+
+        from pytorch_distributed_example_tpu.parallel.ddp import (
+            make_ddp_train_step,
+        )
+
+        sub = tdx.new_group([0, 1], group_desc="planner_ddp_pair")
+        try:
+            rng = np.random.default_rng(5)
+            w0 = rng.standard_normal((8, 4)).astype(np.float32)
+            b0 = np.zeros(4, np.float32)
+            xs = rng.standard_normal((6, 16, 8)).astype(np.float32)
+            ys = rng.standard_normal((6, 16, 4)).astype(np.float32)
+
+            def apply_fn(p, x):
+                return x @ p["w"] + p["b"]
+
+            def loss_fn(logits, y):
+                import jax.numpy as jnp
+
+                return jnp.mean((logits - y) ** 2)
+
+            opt = optax.sgd(0.05)
+
+            def train(enable_planner):
+                plan.enable_for_group(sub, enable_planner)
+                plan.reset_group(sub)
+                step = make_ddp_train_step(
+                    apply_fn, loss_fn, opt, group=sub
+                )
+                params = {"w": w0.copy(), "b": b0.copy()}
+                opt_state = opt.init(params)
+                losses = []
+                for i in range(6):
+                    params, opt_state, loss = step(
+                        params, opt_state, xs[i], ys[i]
+                    )
+                    losses.append(np.asarray(loss).tobytes())
+                return losses, params
+
+            monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+            ring_losses, ring_params = train(True)
+            stock_losses, stock_params = train(False)
+            assert ring_losses == stock_losses  # bitwise, step by step
+            for k in ("w", "b"):
+                assert (
+                    np.asarray(ring_params[k]).tobytes()
+                    == np.asarray(stock_params[k]).tobytes()
+                )
+        finally:
+            plan.enable_for_group(sub, False)
+            tdx.distributed.destroy_process_group(sub)
+
+
+# ---------------------------------------------------------------------------
+# plan.step chaos: named divergence, no hang, bitwise retry
+# ---------------------------------------------------------------------------
+
+
+def _gang_with_verifiers(W, every=1, timeout=4.0, prefix="a0"):
+    st = HashStore(30.0)
+    return [
+        ScheduleVerifier(
+            PrefixStore(f"plansched_{prefix}", st), r, W, "plangang",
+            every=every, timeout=timeout,
+        )
+        for r in range(W)
+    ]
+
+
+class TestPlanStepChaos:
+    def setup_method(self):
+        faults.clear_plan()
+
+    def teardown_method(self):
+        faults.clear_plan()
+
+    def test_corrupt_names_first_divergent_step_on_every_rank(self):
+        """Advisory corrupt at plan.step perturbs one rank's round
+        fingerprint: the next checkpoint raises ScheduleMismatchError on
+        EVERY rank, naming the first divergent planner step."""
+        W, n = 3, 24
+        xs = [np.full(n, float(r + 1), np.float32) for r in range(W)]
+        p = schedules.synthesize("all_reduce", "ring", W, n, _topo(W))
+        faults.install_plan([
+            {"point": "plan.step", "rank": 1, "after": 2,
+             "action": "corrupt"},
+        ], export_env=False)
+        res, errs = _run_gang(
+            p, xs, verifiers=_gang_with_verifiers(W), join_timeout=30.0
+        )
+        assert all(isinstance(e, ScheduleMismatchError) for e in errs), errs
+        for e in errs:
+            msg = str(e)
+            assert "plan.all_reduce.ring" in msg
+            # the corrupt round is round index 1 (2nd plan.step on rank 1)
+            assert "divergen" in msg
+
+    def test_fault_mid_collective_no_hang_survivors_diagnose(self):
+        """A rank KILLED mid-planner-collective (injected error at
+        plan.step): the faulted rank raises the injected DistError; all
+        SURVIVING ranks raise ScheduleMismatchError naming the missing
+        rank and its last planner steps — bounded by the checkpoint
+        timeout, never a hang."""
+        W, n = 3, 24
+        xs = [np.full(n, float(r + 1), np.float32) for r in range(W)]
+        p = schedules.synthesize("all_reduce", "ring", W, n, _topo(W))
+        faults.install_plan([
+            {"point": "plan.step", "rank": 1, "after": 2, "action": "error",
+             "message": "injected mid-plan fault"},
+        ], export_env=False)
+        res, errs = _run_gang(
+            p, xs, verifiers=_gang_with_verifiers(W, timeout=3.0),
+            join_timeout=45.0,
+        )
+        assert isinstance(errs[1], DistError)
+        assert "injected mid-plan fault" in str(errs[1])
+        for r in (0, 2):
+            assert isinstance(errs[r], ScheduleMismatchError), errs[r]
+            assert "did not reach the checkpoint" in str(errs[r])
+            assert "plan.all_reduce.ring" in str(errs[r])
+
+    def test_whole_pass_retry_replays_bitwise(self):
+        """After a transient plan.step fault aborts attempt 0, a whole-
+        pass retry (fresh route + verifiers, same plan and inputs)
+        completes and is bitwise-identical to a never-faulted gang."""
+        W, n = 3, 40
+        rng = np.random.default_rng(13)
+        xs = [rng.standard_normal(n).astype(np.float32) for _ in range(W)]
+        p = schedules.synthesize("all_reduce", "ring", W, n, _topo(W))
+        clean, errs = _run_gang(p, xs, route="clean")
+        assert not any(errs)
+        faults.install_plan([
+            {"point": "plan.step", "rank": 2, "after": 2, "action": "error",
+             "times": 1},
+        ], export_env=False)
+        _, errs0 = _run_gang(
+            p, xs, verifiers=_gang_with_verifiers(W, timeout=3.0),
+            route="try0", join_timeout=45.0,
+        )
+        assert any(errs0)  # attempt 0 really failed somewhere
+        # retry: rule exhausted (times=1); fresh route + verifiers
+        res1, errs1 = _run_gang(
+            p, xs, verifiers=_gang_with_verifiers(W, prefix="a1"),
+            route="try1", join_timeout=45.0,
+        )
+        assert not any(errs1), errs1
+        for r in range(W):
+            assert res1[r].tobytes() == clean[r].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# multiproc p2p-plane lowering, end to end (slow: real process gang)
+# ---------------------------------------------------------------------------
+
+
+PLANNER_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    rank, world, jport, sport = (int(a) for a in sys.argv[1:5])
+    os.environ["TDX_COLLECTIVE_PLANNER"] = "1"
+    os.environ["TDX_PLANNER_FORCE"] = "ring"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        pass  # older jax: XLA_FLAGS was cleared, so 1 device already
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{jport}",
+        num_processes=world,
+        process_id=rank,
+    )
+
+    import numpy as np
+    import pytorch_distributed_example_tpu as tdx
+
+    pg = tdx.init_process_group(
+        backend="xla",
+        init_method=f"tcp://127.0.0.1:{sport}",
+        rank=rank,
+        world_size=world,
+    )
+    assert tdx.distributed._world.mode == "multiproc"
+
+    # all_reduce over the p2p plane (ring schedule, probe-free: forced)
+    t = tdx.DistTensor.from_process_local(
+        np.arange(10, dtype=np.float32) + 100.0 * (rank + 1)
+    )
+    tdx.all_reduce(t)
+    expect = np.arange(10, dtype=np.float32) * world + 100.0 * sum(
+        r + 1 for r in range(world)
+    )
+    got = t.local_numpy()[0]
+    assert np.allclose(got, expect), (got, expect)
+
+    # all_gather
+    t = tdx.DistTensor.from_process_local(
+        np.array([float(rank)], np.float32)
+    )
+    g = tdx.all_gather(t)
+    flat = g.local_numpy()[0][:, 0].tolist()
+    assert flat == [float(r) for r in range(world)], flat
+
+    # reduce_scatter
+    rows = tdx.DistTensor.from_process_local(
+        np.full((world, 3), float(rank + 1), np.float32)
+    )
+    rs = tdx.reduce_scatter(rows)
+    assert rs.local_numpy()[0][0] == sum(r + 1 for r in range(world))
+
+    # the planner plane path really carried those collectives
+    assert getattr(pg, "_plan_route_ctr", 0) >= 3, pg.__dict__.get(
+        "_plan_route_ctr"
+    )
+    from pytorch_distributed_example_tpu import plan as _plan
+    pl = _plan.planner_for_group(pg)
+    assert pl.last_choice is not None and pl.last_choice[1] == "ring"
+
+    tdx.destroy_process_group()
+    print(f"planner worker {rank}: OK")
+    """
+)
+
+
+@pytest.mark.slow
+def test_multiproc_planner_over_p2p_plane(tmp_path):
+    from tests._mp_util import REPO, free_port, worker_env
+
+    world = 2
+    jport, sport = free_port(), free_port()
+    script = tmp_path / "planner_worker.py"
+    script.write_text(PLANNER_WORKER)
+    env = worker_env()
+    env["TDX_PLANNER_PROBE_CACHE"] = ""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), str(jport),
+             str(sport)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, cwd=REPO,
+        )
+        for r in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("planner workers timed out:\n" + "\n".join(outs))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"planner worker {r}: OK" in out
