@@ -522,6 +522,9 @@ def _bench_ddp_mnist(jax, tdx, scan_override=None):
             "reported": reported_how,
             "final_loss": round(final_loss, 4),
             "timing": "readback_barrier",
+            # per-rank train-state footprint (ZeRO weight-update
+            # sharding is the trainer default: opt state ~1/world)
+            "memory": step.memory_report(p, opt_state),
         }
 
     p = ddp.params
@@ -556,6 +559,9 @@ def _bench_ddp_mnist(jax, tdx, scan_override=None):
         "reported": reported_how,
         "final_loss": round(final_loss, 4),
         "timing": "readback_barrier",
+        # per-rank train-state footprint (ZeRO weight-update sharding
+        # is the trainer default: opt state ~1/world per device)
+        "memory": step.memory_report(p, opt_state),
     }
 
 

@@ -30,9 +30,20 @@ size, with the winning algorithm chosen from the measured probe table
 (persisted on disk keyed by topology; `--no-probe-cache` bypasses).
 Self-persists as `allreduce_planner` on TPU.
 
+`--planner --plane-pipeline` additionally A/Bs the p2p-plane EXECUTOR
+variants (ISSUE 10 satellite): every plane candidate — ring, rhd, and
+the chunk-pipelined `ring_pipe` (executor.py: send of chunk i+1
+overlaps the fold of chunk i) — timed over a real in-process plane gang
+of `--plane-world` ranks per sweep size, with the measured timings
+written into the probe cache's PLANE rows (same topology key a
+multiproc gang of that shape detects), so `_agreed_plane_choice` picks
+the pipelined walk only where it measured fastest. Self-persists as
+`plan_pipeline` on TPU.
+
 Usage: python benchmarks/allreduce_bw.py [--max-mb 256] [--op all_reduce]
        python benchmarks/allreduce_bw.py --op quant [--wire int8]
        python benchmarks/allreduce_bw.py --planner [--no-probe-cache]
+       python benchmarks/allreduce_bw.py --planner --plane-pipeline
 """
 
 from __future__ import annotations
@@ -303,6 +314,160 @@ def run_planner(args, tdx, W):
     return rows
 
 
+def run_plane_pipeline(args, tdx):
+    """The `--planner --plane-pipeline` A/B: time EVERY p2p-plane
+    all_reduce candidate (ring / rhd / chunk-pipelined ring_pipe) over a
+    real in-process plane gang per sweep size, and merge the measured
+    timings into the probe cache's plane rows — the honest route for the
+    probe table to pick (or reject) the pipelined executor walk. CPU
+    acceptance = bitwise result parity + a complete measured row set;
+    the speedup summary is the TPU-host/multi-host claim (>= 1.1x
+    target where the fold can hide wire time)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from benchmarks.common import emit, on_tpu, persist_result
+    from pytorch_distributed_example_tpu.plan import (
+        executor, probe, schedules,
+    )
+    from pytorch_distributed_example_tpu.plan.planner import (
+        CollectivePlanner,
+    )
+    from pytorch_distributed_example_tpu.plan.topology import Topology
+    from pytorch_distributed_example_tpu.p2p import P2PPlane
+    from pytorch_distributed_example_tpu.store import HashStore
+
+    W = max(int(args.plane_world), 2)
+    topo = Topology(W, (tuple(range(W)),), "cpu")
+    pl = CollectivePlanner(topo, cache=probe.ProbeCache(
+        None if not args.no_probe_cache else ""
+    ))
+    cands = pl.candidates("all_reduce", "sum", "plane")
+    pipe_chunks = executor.default_pipeline_chunks()
+
+    store = HashStore(60.0)
+    planes = [
+        P2PPlane(r, store, advertise="127.0.0.1").start() for r in range(W)
+    ]
+    try:
+        size = int(args.min_kb * 1024)
+        max_size = int(args.max_mb * 1024 * 1024)
+        rows, best = [], None
+        while size <= max_size:
+            n = max(size // 4, W)
+            gen = np.random.default_rng(0)
+            xs = [
+                gen.standard_normal(n).astype(np.float32) for _ in range(W)
+            ]
+            timings, outs = {}, {}
+
+            def gang(alg, route, iters=None):
+                iters = args.iters if iters is None else iters
+                plan = pl.plan_for("all_reduce", alg, n)
+                pipe = (
+                    pipe_chunks if alg in schedules.EXEC_VARIANTS else 1
+                )
+                res = [None] * W
+                errs = [None] * W
+
+                def worker(r):
+                    try:
+                        for i in range(iters):
+                            res[r] = executor.execute(
+                                plan, r, xs[r], planes[r],
+                                route=f"{route}/{i}", timeout=30.0,
+                                pipeline_chunks=pipe,
+                            )
+                    except Exception as e:  # noqa: BLE001 — bench records
+                        errs[r] = e
+                ts = [
+                    threading.Thread(target=worker, args=(r,))
+                    for r in range(W)
+                ]
+                t0 = _time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(120.0)
+                dt = (_time.perf_counter() - t0) / iters
+                if any(t.is_alive() for t in ts):
+                    # a hung rank must not masquerade as a (terrible)
+                    # measurement — and must never reach the probe cache
+                    raise RuntimeError(
+                        f"plane gang hung at {alg} {size}B (thread alive "
+                        "after 120s join)"
+                    )
+                if any(errs):
+                    raise RuntimeError(f"plane gang failed: {errs}")
+                return dt, res[0]
+
+            for alg in cands:
+                # one warm iteration: connections + plan synthesis
+                gang(alg, f"ppw/{size}/{alg}", iters=1)
+                timings[alg], outs[alg] = gang(alg, f"pp/{size}/{alg}")
+            # an execution VARIANT must be bitwise-identical to its base
+            # (same schedule, same fold order); different ALGORITHMS
+            # legitimately differ in reduction order (allclose only)
+            for alg, out in outs.items():
+                base = schedules.EXEC_VARIANTS.get(alg)
+                if base is not None:
+                    assert out.tobytes() == outs[base].tobytes(), (
+                        f"{alg} result diverged bitwise from {base} at "
+                        f"{size}B"
+                    )
+                else:
+                    np.testing.assert_allclose(
+                        out, outs["ring"], rtol=1e-5, atol=1e-5
+                    )
+            if not args.no_probe_cache:
+                pl.cache.update(
+                    topo.key(), "all_reduce", probe.bucket_bytes(size),
+                    timings, plane="plane",
+                )
+            speed = timings["ring"] / timings["ring_pipe"]
+            rec = emit(
+                f"plan_pipeline_{_fmt(size)}",
+                size / timings["ring_pipe"] / 1e9,
+                "GB/s",
+                bytes=size,
+                world=W,
+                pipeline_chunks=pipe_chunks,
+                us={a: round(t * 1e6, 1) for a, t in timings.items()},
+                ring_pipe_x_vs_ring=round(speed, 3),
+                winner=min(timings, key=timings.get),
+            )
+            rows.append(rec)
+            if best is None or rec["ring_pipe_x_vs_ring"] > best[
+                "ring_pipe_x_vs_ring"
+            ]:
+                best = rec
+            size *= 4
+    finally:
+        for p in planes:
+            p.close()
+    summary = emit(
+        "plan_pipeline_summary",
+        best["ring_pipe_x_vs_ring"] if best else 0.0,
+        "x_vs_ring",
+        best_row=best["metric"] if best else "",
+        world=W,
+        # CPU acceptance is the honest A/B itself: bitwise variant
+        # parity + a complete measured candidate set in the cache (the
+        # table may well KEEP the plain walk — on a loaded loopback
+        # host the extra frames usually lose). The >= 1.1x speedup is
+        # the real-wire (TPU-host / multi-host) claim.
+        target_multihost=1.1,
+        cached=not args.no_probe_cache,
+        candidates=list(cands),
+        rows=rows,
+    )
+    if on_tpu() and best:
+        persist_result("plan_pipeline", summary)
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=256.0)
@@ -325,6 +490,16 @@ def main():
         help="--planner: ignore and do not write the on-disk probe "
         "cache (sets TDX_PLANNER_PROBE_CACHE='')",
     )
+    ap.add_argument(
+        "--plane-pipeline", action="store_true",
+        help="--planner: A/B the p2p-plane executor variants (ring vs "
+        "chunk-pipelined ring_pipe) over an in-process plane gang and "
+        "feed the measured timings to the probe cache's plane rows",
+    )
+    ap.add_argument(
+        "--plane-world", type=int, default=4,
+        help="--plane-pipeline: gang size for the in-process plane A/B",
+    )
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=5)
     args = ap.parse_args()
@@ -334,6 +509,10 @@ def main():
     import pytorch_distributed_example_tpu as tdx
 
     from benchmarks.common import device_sync, emit
+
+    if args.planner and args.plane_pipeline:
+        # plane-executor A/B: no device mesh involved — pure p2p plane
+        return run_plane_pipeline(args, tdx)
 
     if not tdx.is_initialized():
         tdx.init_process_group(backend="xla")

@@ -116,6 +116,42 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # p2p-plane executor variants A/B (ISSUE 10 satellite): ring
+            # vs chunk-pipelined ring_pipe over a real in-process plane
+            # gang; measured timings land in the probe cache's plane
+            # rows (hermetic in quick mode)
+            "plan_pipeline",
+            [sys.executable, "benchmarks/allreduce_bw.py", "--planner",
+             "--plane-pipeline"]
+            + (
+                ["--no-probe-cache", "--min-kb", "64", "--max-mb", "1",
+                 "--iters", "3"]
+                if q
+                else ["--min-kb", "64", "--max-mb", "16", "--iters", "5"]
+            ),
+            {},
+        ),
+        (
+            # ZeRO weight-update sharding capability headline (ISSUE 10):
+            # a transformer-LM whose unsharded optimizer state exceeds
+            # the per-rank budget trains under shard_weight_update=auto;
+            # >= 1.8x measured opt-state reduction at world 2
+            "zero_auto_mem",
+            [sys.executable, "benchmarks/zero_bench.py", "--mode", "mem"]
+            + (["--quick", "--steps", "2"] if q else ["--steps", "4"]),
+            {"TDX_CPU_DEVICES": "2"},  # the world-2 acceptance geometry
+        ),
+        (
+            # ZeRO parity row (ISSUE 10): auto vs off from the same init
+            # on ConvNet + transformer-LM; worst rel param diff <= 1e-5
+            # (measures bitwise on CPU)
+            "zero_auto_parity",
+            [sys.executable, "benchmarks/zero_bench.py", "--mode",
+             "parity"]
+            + (["--quick", "--steps", "3"] if q else ["--steps", "6"]),
+            {},
+        ),
+        (
             "resnet_ddp",
             [sys.executable, "benchmarks/resnet_ddp.py"]
             + (["--steps", "5", "--warmup", "2", "--batch", "32"] if q else []),

@@ -108,7 +108,12 @@ def main() -> int:
     # --- unrolled K=8 (same fusion boundary, no loop machinery) -----
     # fresh wrap: the per-step phase DONATED ddp.params' buffers
     ddp3 = tdx.DistributedDataParallel(model, params)
-    step3 = ddp3.make_train_step(opt, loss_fn, has_rng=True)
+    # shard_weight_update="off": this probe drives the RAW jitted program
+    # with a plain optax state (the ZeRO default would specialize the
+    # program to the sharded state layout at first dispatch)
+    step3 = ddp3.make_train_step(
+        opt, loss_fn, has_rng=True, shard_weight_update="off"
+    )
     base = step3._jitted  # (params, opt, hook_state, x, y, rng)
 
     @jax.jit

@@ -49,12 +49,9 @@ def main():
     import jax
 
     if getattr(args, "cpu", False) or os.environ.get("TDX_EXAMPLES_CPU"):
-        # this box's sitecustomize pins the TPU plugin; env alone cannot
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update(
-            "jax_num_cpu_devices",
-            int(os.environ.get("TDX_EXAMPLES_CPU_DEVICES", "2")),
-        )
+        from pytorch_distributed_example_tpu._compat import force_cpu_devices
+
+        force_cpu_devices(int(os.environ.get("TDX_EXAMPLES_CPU_DEVICES", "2")))
 
     import jax.numpy as jnp
     import optax

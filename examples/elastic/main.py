@@ -46,8 +46,9 @@ def main():
     import jax
 
     if args.cpu or os.environ.get("TDX_ELASTIC_CPU"):
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 1)
+        from pytorch_distributed_example_tpu._compat import force_cpu_devices
+
+        force_cpu_devices(1)
 
     import jax.numpy as jnp
     import numpy as np

@@ -258,8 +258,9 @@ def main():
     import jax
 
     if args.cpu or __import__("os").environ.get("TDX_EXAMPLES_CPU"):
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 2)
+        from pytorch_distributed_example_tpu._compat import force_cpu_devices
+
+        force_cpu_devices(2)
     import jax.numpy as jnp
     import optax
 

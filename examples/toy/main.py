@@ -64,9 +64,9 @@ def main():
         # group creation
         os.environ["TDX_SCHEDULE_CHECK"] = "1"
     if args.cpu or os.environ.get("TDX_EXAMPLES_CPU"):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        from pytorch_distributed_example_tpu._compat import force_cpu_devices
+
+        force_cpu_devices(8)
 
     tdx.init_process_group(
         backend=args.backend,

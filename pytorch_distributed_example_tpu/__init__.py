@@ -120,8 +120,15 @@ from .dtensor import (  # noqa: F401
     Shard,
     distribute_module,
     distribute_tensor,
+    redistribute_for_serving,
+    redistribute_tree,
     unwrap_module,
 )
-from .checkpoint_sharded import DCPCheckpointer, dcp_load, dcp_save  # noqa: F401
+from .checkpoint_sharded import (  # noqa: F401
+    DCPCheckpointer,
+    dcp_load,
+    dcp_save,
+    resharded_template,
+)
 
 __version__ = "0.1.0"

@@ -60,6 +60,35 @@ def axis_size(axis_name):
     return lax.psum(1, axis_name)
 
 
+def force_cpu_devices(n: int) -> None:
+    """Pin the process to an ``n``-device virtual CPU mesh, across jax
+    versions: newer jax has the `jax_num_cpu_devices` config; older jax
+    only honors the XLA host-platform flag, which works as long as it
+    lands before the first backend touch. (The examples' `--cpu` path —
+    this box's sitecustomize pins the TPU plugin, so the env var alone
+    cannot.)"""
+    import os
+    import re
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={int(n)}"
+        if "xla_force_host_platform_device_count" in flags:
+            # REPLACE a pre-existing pin: silently keeping a different
+            # count would resolve an 8-way request to someone else's 2
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = f"{flags} {want}"
+        os.environ["XLA_FLAGS"] = flags.strip()
+
+
 def tpu_compiler_params(**kwargs):
     """Pallas-TPU compiler params across the
     `TPUCompilerParams` -> `CompilerParams` rename."""

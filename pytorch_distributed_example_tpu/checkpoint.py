@@ -347,11 +347,46 @@ def _load_verified(
             f"checkpoint/template structure mismatch; missing={sorted(missing)[:3]} "
             f"extra={sorted(extra_k)[:3]}"
         )
-    for a, t in zip(host, t_leaves):
-        if tuple(a.shape) != tuple(np.shape(t)):
-            raise ValueError(
-                f"shape mismatch: checkpoint {a.shape} vs template {np.shape(t)}"
-            )
+    fixed = []
+    for leaf_path, a, t in zip(t_paths, host, t_leaves):
+        tshape = tuple(np.shape(t))
+        if tuple(a.shape) != tshape:
+            # ZeRO weight-update sharding (parallel/zero.py) checkpoints
+            # optimizer-state vector leaves as padded flats of
+            # W*ceil(size/W) elements. Those restore value-preservingly
+            # into the template's shape by stripping the zero pad —
+            # which also makes the checkpoint world-size-portable (the
+            # trainer re-pads for ITS world on first dispatch). Anything
+            # else is a genuine mismatch.
+            n = int(np.prod(tshape, dtype=np.int64)) if tshape else 1
+            # a legit ZeRO pad is all zeros (zero grads keep moments
+            # and updates at 0 in the pad region) and < one shard —
+            # bounded here by max(n, 256) so any world <= 256 and any
+            # world <= n both pass; anything else still raises rather
+            # than silently truncating. Residual window: a same-
+            # structure checkpoint whose 1-D leaf is modestly larger
+            # with a zero tail — but a zero tail on a non-ZeRO leaf
+            # means a FRESH (all-zero) moment, and truncating zeros
+            # loads exactly what a fresh init would: benign.
+            # only OPTIMIZER-STATE leaves are ever saved padded-flat;
+            # a mis-sized PARAM leaf keeps the hard raise
+            if (
+                "opt_state" in leaf_path.split("/", 1)[0]
+                and a.ndim == 1
+                and a.size >= n
+                and a.size - n <= max(n, 256)
+                and not np.any(a[n:])
+            ):
+                a = a[:n].reshape(tshape)
+            else:
+                raise ValueError(
+                    f"shape mismatch: checkpoint {a.shape} vs template "
+                    f"{tshape} (flat leaves load only as ZeRO "
+                    "padded-flats: 1-D, >= template size, bounded "
+                    "zero-tail pad)"
+                )
+        fixed.append(a)
+    host = fixed
     restored = _unflatten(treedef, payload, host)
     params = restored["params"]
     opt_state = restored.get("opt_state")

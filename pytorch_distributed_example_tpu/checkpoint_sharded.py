@@ -34,7 +34,13 @@ from .checkpoint import (
     write_manifest,
 )
 
-__all__ = ["dcp_save", "dcp_async_save", "dcp_load", "DCPCheckpointer"]
+__all__ = [
+    "dcp_save",
+    "dcp_async_save",
+    "dcp_load",
+    "DCPCheckpointer",
+    "resharded_template",
+]
 
 
 def _checkpointer():
@@ -139,6 +145,42 @@ def dcp_async_save(state: Any, path: str, *, force: bool = True) -> AsyncSaveHan
     ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
     ckptr.save(path, state, force=force)
     return AsyncSaveHandle(ckptr, path)
+
+
+def resharded_template(tree: Any, mesh, specs: Any = None,
+                       rules: Any = None) -> Any:
+    """Memory-light restore template for a DIFFERENT topology than the
+    checkpoint was saved under: `jax.ShapeDtypeStruct`s carrying the
+    target mesh's shardings, so `dcp_load` reshards ON LOAD — a world-2
+    ZeRO/FSDP checkpoint restores straight into a world-1 (or world-4)
+    gang with each process reading only the bytes its shards need, and
+    never materializing a replicated tree (the DCP re-topology
+    guarantee; same redistribution discipline as
+    `dtensor.redistribute_tree` for in-memory trees).
+
+    ``tree`` supplies shapes/dtypes (arrays or ShapeDtypeStructs);
+    layout comes from ``specs`` (a PartitionSpec pytree) or ``rules``
+    (a `parallel.sharding` rule table); with neither, every leaf
+    replicates over ``mesh``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .parallel import sharding as shd
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    if specs is None:
+        if rules is not None:
+            specs = shd.make_param_specs(tree, rules, jmesh)
+        else:
+            specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), tree)
+
+    def one(leaf, spec):
+        return jax.ShapeDtypeStruct(
+            tuple(leaf.shape), leaf.dtype,
+            sharding=NamedSharding(jmesh, spec),
+        )
+
+    return jax.tree_util.tree_map(one, tree, specs)
 
 
 def dcp_load(template: Any, path: str) -> Any:

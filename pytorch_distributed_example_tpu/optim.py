@@ -9,6 +9,12 @@
   axis and re-pinning them inside the compiled step via sharding
   constraints — XLA keeps the optimizer math partitioned. Drop-in with
   `DistributedDataParallel.make_train_step` and the ZeRO-2 step.
+
+  NOTE: since `shard_weight_update="auto"` became the trainer default
+  (ROADMAP item 3), the DDP/ZeRO train-step factories materialize the
+  optimizer state shard-only on their own — this wrapper remains for
+  the torch-shaped surface (`consolidate_state_dict`) and for eager /
+  custom steps that do not go through a factory.
 * `PostLocalSGDOptimizer` — torch
   (`torch/distributed/optim/post_localSGD_optimizer.py`): local steps +
   periodic parameter averaging; composes `parallel/localsgd.py`'s

@@ -17,6 +17,7 @@ from .localsgd import (  # noqa: F401
     unstack_replicas,
 )
 from . import sharding  # noqa: F401
+from . import zero  # noqa: F401  (ZeRO weight-update shard layout algebra)
 from .fsdp import (  # noqa: F401
     FSDPModule,
     fully_shard,

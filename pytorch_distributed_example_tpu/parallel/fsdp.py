@@ -183,7 +183,7 @@ def _make_constrained_train_step(
         grads = constrain_grads(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if constrain_opt_state is not None:
-            opt_state = constrain_opt_state(opt_state)
+            opt_state = constrain_opt_state(opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         params = constrain_params(params)
         return params, opt_state, loss
@@ -199,6 +199,18 @@ def _make_constrained_train_step(
     )
 
 
+def _check_swu(shard_weight_update: str) -> bool:
+    """Resolve the tri-state `shard_weight_update` flag for the GSPMD
+    family (here "auto" and "force" coincide: the mesh axis exists by
+    construction, so sharding is always possible)."""
+    if shard_weight_update not in ("auto", "off", "force"):
+        raise ValueError(
+            f"shard_weight_update={shard_weight_update!r}; expected "
+            "'auto', 'off', or 'force'"
+        )
+    return shard_weight_update != "off"
+
+
 def make_fsdp_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -209,35 +221,103 @@ def make_fsdp_train_step(
     has_rng: bool = False,
     remat: bool = False,
     donate: bool = True,
+    shard_weight_update: str = "auto",
 ):
     """Compile the FSDP (ZeRO-3) train step: batch split over data axes,
     params sharded per ``param_specs``; XLA GSPMD materializes the
     per-layer gather/scatter.
+
+    `shard_weight_update="auto"` (default) pins the optimizer state to
+    the PARAM layout explicitly (under ZeRO-3 the moments mirror the
+    sharded params — the constraint makes that a contract instead of a
+    propagation accident) and attaches `step.init_opt_state(params)`.
+    "off" constrains the state REPLICATED — the world-x-redundant
+    baseline the memory bench A/Bs against.
     """
     import jax
     from jax.sharding import NamedSharding
 
     jmesh = getattr(mesh, "jax_mesh", mesh)
+    sharded_update = _check_swu(shard_weight_update)
     # grads + updated params stay in the param layout (reduce-scatter
     # falls out of SPMD)
     in_layout = lambda tree: shd.constrain(tree, jmesh, param_specs)
     pshard = jax.tree_util.tree_map(
         lambda s: NamedSharding(jmesh, s), param_specs
     )
-    return _make_constrained_train_step(
+
+    def constrain_state(opt_state, params):
+        # optimizer state mirrors the params tree leaf-for-leaf in its
+        # moment subtrees; shape-match each state leaf to its param's
+        # spec so the moments provably stay in the param layout
+        if sharded_update:
+            return _constrain_like_params(opt_state, params, jmesh,
+                                          param_specs)
+        return shd.constrain(
+            opt_state, jmesh, shd.replicated_specs(opt_state)
+        )
+
+    step = _make_constrained_train_step(
         apply_fn,
         loss_fn,
         optimizer,
         jmesh,
         _batch_spec(jmesh, data_axes),
         constrain_grads=in_layout,
-        constrain_opt_state=None,
+        constrain_opt_state=constrain_state,
         constrain_params=in_layout,
         param_sharding=pshard,
         has_rng=has_rng,
         remat=remat,
         donate=donate,
     )
+
+    def init_opt_state(params):
+        """State placed in its step-native layout: `optimizer.init` on
+        the (already sharded) params — zeros_like inherits the param
+        shardings, so moments land sharded with no extra transfer."""
+        return jax.jit(optimizer.init)(params)
+
+    step.init_opt_state = init_opt_state
+    step.weight_update_sharded = sharded_update
+    return step
+
+
+def _constrain_like_params(opt_state, params, jmesh, param_specs):
+    """Constrain opt-state leaves to their OWN param's spec by tree-path
+    suffix: optax moment subtrees (mu/nu/trace) embed the full params
+    tree, so a state leaf's path ends with its param's path — matching
+    by path (shape as a guard) keeps q_proj and o_proj moments in their
+    respective layouts even when the kernels share a shape with
+    transposed specs (the Megatron colwise/rowwise pair). Unmatched
+    non-scalar leaves replicate (step counts, schedule state)."""
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_leaves(param_specs)
+    by_path = [
+        (shd.path_of(kp), tuple(leaf.shape), spec)
+        for (kp, leaf), spec in zip(flat_p, flat_s)
+    ]
+
+    def one(kp, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim < 1:
+            return leaf
+        path = shd.path_of(kp)
+        spec, best = P(), -1
+        for ppath, pshape, pspec in by_path:
+            # anchor on a path-COMPONENT boundary ('mu/up_proj/kernel'
+            # must not string-match 'proj/kernel') and keep the longest
+            # suffix, so nested prefixes resolve to the nearest param
+            if tuple(leaf.shape) == pshape and (
+                path == ppath or path.endswith("/" + ppath)
+            ) and len(ppath) > best:
+                spec, best = pspec, len(ppath)
+        return lax.with_sharding_constraint(leaf, NamedSharding(jmesh, spec))
+
+    return jax.tree_util.tree_map_with_path(one, opt_state)
 
 
 def make_zero2_train_step(
@@ -251,6 +331,7 @@ def make_zero2_train_step(
     remat: bool = False,
     donate: bool = True,
     comm_hook: Optional[Callable] = None,
+    shard_weight_update: str = "auto",
 ):
     """ZeRO-2: params REPLICATED, gradients + optimizer state SHARDED.
 
@@ -278,13 +359,20 @@ def make_zero2_train_step(
     hook: its params are sharded, so they cannot ride a replicated
     shard_map region without un-sharding them.
 
-    Pair with `shard_optimizer_only(opt_state, mesh, axis)` for the
-    initial opt-state placement.
+    `shard_weight_update="auto"` (default) IS the ZeRO-2 semantics
+    described above, with the opt-in `shard_optimizer_only` placement
+    internalized as `step.init_opt_state(params)`; "off" reverts to the
+    replicated update (grads all-reduced, state replicated — a GSPMD
+    DDP step, the memory bench's baseline).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     jmesh = getattr(mesh, "jax_mesh", mesh)
+    sharded_update = _check_swu(shard_weight_update)
     constrain_dim0 = lambda tree: shd.constrain_dim0(tree, jmesh, axis)
+    replicate = lambda tree: shd.constrain(
+        tree, jmesh, shd.replicated_specs(tree)
+    )
 
     hook_axis = None
     if comm_hook is not None:
@@ -305,14 +393,19 @@ def make_zero2_train_step(
             )
         hook_axis = present[0]
 
-    return _make_constrained_train_step(
+    step = _make_constrained_train_step(
         apply_fn,
         loss_fn,
         optimizer,
         jmesh,
         _batch_spec(jmesh, data_axes),
-        constrain_grads=constrain_dim0,  # -> reduce-scatter, not all-reduce
-        constrain_opt_state=constrain_dim0,  # state stays 1/W per device
+        # sharded: -> reduce-scatter, not all-reduce; state 1/W/device
+        constrain_grads=constrain_dim0 if sharded_update else replicate,
+        constrain_opt_state=(
+            (lambda s, p: constrain_dim0(s))
+            if sharded_update
+            else (lambda s, p: replicate(s))
+        ),
         # replicated output -> one all-gather of the updates
         constrain_params=lambda p: shd.constrain(
             p, jmesh, shd.replicated_specs(p)
@@ -324,6 +417,20 @@ def make_zero2_train_step(
         comm_hook=comm_hook,
         hook_axis=hook_axis,
     )
+
+    def init_opt_state(params):
+        """State in the step's native layout: dim-0 sharded over
+        ``axis`` under the (default) sharded update — the
+        `shard_optimizer_only` placement, now internal — replicated
+        under "off"."""
+        state = optimizer.init(params)
+        if sharded_update:
+            return shard_optimizer_only(state, jmesh, axis)
+        return state
+
+    step.init_opt_state = init_opt_state
+    step.weight_update_sharded = sharded_update
+    return step
 
 
 def shard_optimizer_only(opt_state, mesh, axis: str = "fsdp"):

@@ -223,30 +223,40 @@ def ddp_comm_hook(group):
 
 
 def _agreed_plane_choice(group, me: int, op_name: str, per_rank_bytes: int,
-                         reduce_kind: str, pl) -> str:
-    """Gang-agreed algorithm for a plane collective. Each process may
-    hold a DIFFERENT probe cache (per-host disks), so a purely local
-    `choose()` could hand two ranks two different schedules — a
-    divergence the verifier would only catch after the fact. Group rank
-    0's choice is published through the (incarnation-scoped) group
-    store once per (op, bucket); everyone else adopts it."""
+                         reduce_kind: str, pl):
+    """Gang-agreed (algorithm, pipeline_chunks) for a plane collective.
+    Each process may hold a DIFFERENT probe cache (per-host disks) — and
+    a different TDX_PLAN_PIPELINE_CHUNKS env — so a purely local
+    `choose()` could hand two ranks two different schedules or chunk
+    splits — divergences the verifier would only catch after the fact.
+    Group rank 0's choice (chunk count included: frame sizes and
+    per-peer sequence numbers depend on it) is published through the
+    (incarnation-scoped) group store once per (op, bucket); everyone
+    else adopts it."""
     bucket = probe.bucket_bytes(per_rank_bytes)
     agreed = pl.__dict__.setdefault("_agreed_plane", {})
     hit = agreed.get((op_name, bucket))
     if hit is not None:
         return hit
     alg, _source = pl.choose(op_name, per_rank_bytes, reduce_kind, "plane")
+    pipe = (
+        executor.default_pipeline_chunks()
+        if alg in schedules.EXEC_VARIANTS
+        else 1
+    )
     if group.store is not None and group.size() > 1:
         from .. import distributed as dist
 
         key = f"planalg/gen{dist._world.scope}/{op_name}/{bucket}"
         if me == 0:
-            group.store.set(key, alg.encode())
+            group.store.set(key, f"{alg}:{pipe}".encode())
         else:
             group.store.wait([key], group.timeout)
-            alg = group.store.get(key).decode()
-    agreed[(op_name, bucket)] = alg
-    return alg
+            raw = group.store.get(key).decode()
+            alg, _, p = raw.partition(":")
+            pipe = int(p) if p else 1
+    agreed[(op_name, bucket)] = (alg, pipe)
+    return alg, pipe
 
 
 def _lower_plane(group, op_name: str, array, reduce_kind: str,
@@ -297,7 +307,7 @@ def _lower_plane(group, op_name: str, array, reduce_kind: str,
         local = np.concatenate(
             [np.asarray(s.data) for s in shards], axis=0
         )[0]
-        alg = _agreed_plane_choice(
+        alg, pipeline = _agreed_plane_choice(
             group, me, op_name, max(local.nbytes, 1), reduce_kind, pl
         )
         if op_name == "reduce_scatter":
@@ -305,6 +315,11 @@ def _lower_plane(group, op_name: str, array, reduce_kind: str,
         else:
             nelems = int(local.size)
         plan = pl.plan_for(op_name, alg, nelems)
+        # execution variants: same plan, pipelined executor walk. Both
+        # the variant AND its chunk count are rank-agreed above (frame
+        # sizes and per-peer sequence numbers depend on the split), and
+        # the count also rides the verified |pipeN round descriptors —
+        # every rank pipelines (or not) in lockstep.
         ctr = getattr(group, "_plan_route_ctr", 0)
         group._plan_route_ctr = ctr + 1
         route = f"plan/{dist._world.scope}/{group.group_name}/{ctr}"
@@ -316,6 +331,7 @@ def _lower_plane(group, op_name: str, array, reduce_kind: str,
             timeout=group.timeout,
             verifier=getattr(group, "_sched", None),
             to_global=group.get_global_rank,
+            pipeline_chunks=pipeline,
         )
         if op_name == "all_reduce":
             out_local = np.asarray(res, dtype=local.dtype).reshape(local.shape)

@@ -29,6 +29,7 @@ both ends of every pair count identically.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -36,7 +37,51 @@ import numpy as np
 from .. import faults
 from .schedules import Plan
 
-__all__ = ["execute", "combine_for"]
+__all__ = ["execute", "combine_for", "default_pipeline_chunks",
+           "split_chunks"]
+
+_ENV_PIPE = "TDX_PLAN_PIPELINE_CHUNKS"
+
+
+def default_pipeline_chunks() -> int:
+    """Sub-chunk count for pipelined rounds (the "ring_pipe" execution
+    variant); >= 2 to overlap, env-tunable for the bench A/B."""
+    try:
+        return max(2, int(os.environ.get(_ENV_PIPE, "4")))
+    except ValueError:
+        return 4
+
+
+def _send_recv_overlap(per_rank_steps) -> bool:
+    """True when one rank both sends from and receives into overlapping
+    buffer ranges within a single round."""
+    sends = [
+        (s.offset, s.offset + s.length)
+        for s in per_rank_steps if s.kind == "send"
+    ]
+    recvs = [
+        (s.offset, s.offset + s.length)
+        for s in per_rank_steps if s.kind in ("copy", "reduce")
+    ]
+    return any(a < d and c < b for a, b in sends for c, d in recvs)
+
+
+def split_chunks(offset: int, length: int, chunks: int):
+    """Deterministic sub-chunk split of a [offset, offset+length) segment
+    — both ends of a pair derive the identical split from the shared
+    plan, so per-peer sequence numbers stay aligned. Short segments
+    yield fewer (never empty) chunks."""
+    chunks = min(max(int(chunks), 1), max(int(length), 1))
+    base, rem = divmod(int(length), chunks)
+    out = []
+    off = int(offset)
+    for i in range(chunks):
+        n = base + (1 if i < rem else 0)
+        if n <= 0:
+            continue
+        out.append((off, n))
+        off += n
+    return out
 
 
 def combine_for(reduce_kind: str) -> Callable:
@@ -62,13 +107,27 @@ def execute(
     timeout: float = 60.0,
     verifier=None,
     to_global: Optional[Callable[[int], int]] = None,
+    pipeline_chunks: int = 1,
 ) -> np.ndarray:
     """Run ``plan`` as group-rank ``rank`` over ``plane``; returns this
     rank's result (all_reduce: full payload; all_gather: (W, n) stack;
     reduce_scatter: own chunk). ``payload`` is this rank's flat input
     (all_reduce: (n,); all_gather: (n,); reduce_scatter: (W*cs,) chunk
     list). ``to_global`` maps group ranks to the plane's global ranks
-    (identity when the group IS the world)."""
+    (identity when the group IS the world).
+
+    ``pipeline_chunks > 1`` pipelines each round: segments split into
+    sub-chunks and the send of chunk i+1 overlaps the receive+reduce of
+    chunk i (while this rank folds chunk i, chunk i+1's bytes are in
+    flight and the peer is folding its own previous chunk — the
+    planner's "ring_pipe" execution variant). Rounds containing a
+    ``reduce_any`` step on ANY rank stay unpipelined — the decision is
+    a function of the shared plan, so every rank splits identically and
+    per-peer sequence numbers stay aligned; the round descriptor gains
+    a ``|pipe{C}`` suffix so the schedule verifier catches a gang whose
+    ranks disagree on chunking. Folding order within a segment is
+    ascending offset either way, so pipelined results are BITWISE
+    identical to unpipelined (pinned in tests/test_planner.py)."""
     gmap = to_global if to_global is not None else (lambda r: r)
     combine = combine_for(reduce_kind)
     flat = np.ascontiguousarray(payload).reshape(-1)
@@ -102,9 +161,31 @@ def execute(
         recv_seq[peer] = s + 1
         return s
 
+    pipe = max(int(pipeline_chunks), 1)
+
+    def fold(s, off, n):
+        val = plane.recv(gmap(s.peer), route, 0, next_recv(s.peer), timeout)
+        seg = buf[off:off + n]
+        if s.kind == "copy":
+            seg[...] = val
+        else:
+            combine(seg, val.astype(dtype, copy=False), out=seg)
+
     step_seq = 0
     for rnd in plan.rounds:
         desc = rnd.descriptor()
+        # pipelining is decided from the WHOLE round (every rank sees
+        # the same plan, so every rank splits — or does not — in
+        # lockstep); reduce_any rounds (hier leader fan-in) keep the
+        # one-frame-per-member contract, and a round where any rank's
+        # send segment overlaps its recv segment must ship the send
+        # before folding mutates the buffer (no current schedule does,
+        # but the plan — not the synthesizer — is the contract here)
+        pipelined = pipe > 1 and not any(
+            s.kind == "reduce_any" for per in rnd.steps for s in per
+        ) and not any(_send_recv_overlap(per) for per in rnd.steps)
+        if pipelined:
+            desc += f"|pipe{pipe}"
         # the fault seam fires before the fingerprint so an advisory
         # corrupt rule can perturb what gets recorded; generic actions
         # (error/hang/crash) fire here too — before any socket op of
@@ -122,6 +203,34 @@ def execute(
             )
         step_seq += 1
         my = rnd.steps[rank]
+        if pipelined:
+            send_parts = [
+                (s, split_chunks(s.offset, s.length, pipe))
+                for s in my if s.kind == "send"
+            ]
+            recv_parts = [
+                (s, split_chunks(s.offset, s.length, pipe))
+                for s in my if s.kind in ("copy", "reduce")
+            ]
+            K = max(
+                (len(p) for _, p in send_parts + recv_parts), default=0
+            )
+            for k in range(K + 1):
+                # send chunk k first, THEN fold chunk k-1: the fold's
+                # numpy work happens while chunk k is on the wire
+                for s, parts in send_parts:
+                    if k < len(parts):
+                        off, n = parts[k]
+                        plane.send(
+                            gmap(s.peer), route, 0, next_send(s.peer),
+                            buf[off:off + n], timeout,
+                        )
+                if k >= 1:
+                    for s, parts in recv_parts:
+                        if k - 1 < len(parts):
+                            off, n = parts[k - 1]
+                            fold(s, off, n)
+            continue
         for s in my:
             if s.kind == "send":
                 plane.send(
@@ -130,14 +239,7 @@ def execute(
                 )
         for s in my:
             if s.kind in ("copy", "reduce"):
-                val = plane.recv(
-                    gmap(s.peer), route, 0, next_recv(s.peer), timeout
-                )
-                seg = buf[s.offset:s.offset + s.length]
-                if s.kind == "copy":
-                    seg[...] = val
-                else:
-                    combine(seg, val.astype(dtype, copy=False), out=seg)
+                fold(s, s.offset, s.length)
             elif s.kind == "reduce_any":
                 # take contributions off the wire in arrival order
                 # (latency), fold them in sorted-peer order (bitwise

@@ -71,6 +71,13 @@ class CollectivePlanner:
                 # single-host hier degenerates to a star through one
                 # leader; keep it only when there are hosts to layer over
                 cands = tuple(a for a in cands if a != "hier")
+            # execution variants ride AFTER their base (the structural
+            # default — cands[0] — stays the plain schedule; a variant
+            # only wins through a measured cache/probe row)
+            cands += tuple(
+                v for v, base in schedules.EXEC_VARIANTS.items()
+                if base in cands and op == "all_reduce"
+            )
         if reduce_kind not in ("sum", "avg") and op == "all_reduce":
             cands = tuple(a for a in cands if a != "ring" or plane != "driver")
         return cands
@@ -88,7 +95,7 @@ class CollectivePlanner:
             if forced in cands:
                 self.last_choice = (op, forced, "force")
                 return forced, "force"
-            known = {"onepass"} | {
+            known = {"onepass"} | set(schedules.EXEC_VARIANTS) | {
                 a for algs in schedules.ALGORITHMS.values() for a in algs
             }
             if forced not in known:
@@ -112,8 +119,27 @@ class CollectivePlanner:
             self.last_choice = (op,) + hit
             return hit
         timings = self.cache.lookup(self.topology.key(), op, bucket, plane)
+        # a cache row is usable when it covers every BASE algorithm:
+        # execution variants (ring_pipe) without a measured row simply
+        # are not selectable — discarding a complete pre-variant row
+        # would silently revert a measured rhd/ring win to the
+        # structural default
+        required = {a for a in cands if a not in schedules.EXEC_VARIANTS}
+        usable = timings is not None and required <= set(timings)
+        if not usable:
+            # no usable cache row and we are INSIDE a jit trace (the DDP
+            # comm hook chooses per leaf at trace time): probing would
+            # run compiled programs under the tracer and explode — take
+            # the structural default WITHOUT memoizing, so a later eager
+            # dispatch at this bucket still probes for real
+            import jax
+
+            if plane == "driver" and not jax.core.trace_state_clean():
+                alg = cands[0]  # driver candidates lead with "onepass"
+                self.last_choice = (op, alg, "default")
+                return alg, "default"
         source = "cache"
-        if timings is None or not set(cands) <= set(timings):
+        if not usable:
             timings = self._probe(op, cands, bucket, reduce_kind, plane)  # distlint: disable=R001 -- probe programs run on the DRIVER plane of a single-controller process only (plan/__init__ gates the hook and plane choices so no multi-controller rank ever probes unilaterally); the multiproc plane prober is a no-op and _agreed_plane_choice store-publishes rank 0's choice
             source = "probe"
             if timings is None:  # probing impossible: structural default
@@ -162,6 +188,9 @@ class CollectivePlanner:
     # -- plans -------------------------------------------------------------
 
     def plan_for(self, op: str, algorithm: str, nelems: int) -> schedules.Plan:
+        # execution variants (ring_pipe) share their base's schedule;
+        # only the executor walk differs
+        algorithm = schedules.EXEC_VARIANTS.get(algorithm, algorithm)
         key = (op, algorithm, int(nelems))
         plan = self._plans.get(key)
         if plan is None:

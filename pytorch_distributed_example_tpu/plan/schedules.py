@@ -330,3 +330,12 @@ ALGORITHMS = {
     "all_gather": ("ring",),
     "reduce_scatter": ("ring",),
 }
+
+# execution VARIANTS: same synthesized schedule, different executor
+# behavior — "ring_pipe" walks the ring plan with chunk pipelining
+# (executor.py pipeline_chunks: send of chunk i+1 overlaps the fold of
+# chunk i). The planner treats a variant as a first-class p2p-plane
+# candidate; `plan_for` synthesizes the BASE schedule.
+EXEC_VARIANTS = {"ring_pipe": "ring"}
+
+__all__.append("EXEC_VARIANTS")

@@ -1,0 +1,94 @@
+"""Host-side per-device memory accounting for train-state pytrees.
+
+The ZeRO capability headline ("optimizer state that does not fit
+per-rank unsharded trains under `shard_weight_update=auto`") needs a
+number, not a vibe: these helpers walk a pytree and report how many
+bytes ONE device holds for it, honoring shardings — a replicated leaf
+costs its full size per device, a dim-0-sharded leaf 1/W. Pure host
+arithmetic over `sharding.shard_shape` (no device sync, no allocation),
+so train steps and benches can call it every step for peaks.
+
+`train_memory_report` is the bench-JSON shape: global + per-device
+bytes for params / optimizer state / grads plus the reduction ratio
+the sharded layout buys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "leaf_device_bytes",
+    "tree_bytes",
+    "tree_device_bytes",
+    "train_memory_report",
+]
+
+
+def _itemsize(leaf) -> int:
+    import numpy as np
+
+    dt = getattr(leaf, "dtype", None)
+    return int(np.dtype(dt).itemsize) if dt is not None else 8
+
+
+def leaf_device_bytes(leaf) -> int:
+    """Bytes ONE device holds for this leaf: the shard shape's extent
+    when a `Sharding` is attached, the full size otherwise (host arrays
+    and abstract values count as unsharded)."""
+    import numpy as np
+
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            shape = tuple(sharding.shard_shape(shape))
+        except (TypeError, ValueError):
+            pass
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return n * _itemsize(leaf)
+
+
+def tree_bytes(tree) -> int:
+    """Global logical bytes of every array leaf (sharding-agnostic)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        total += n * _itemsize(leaf)
+    return total
+
+
+def tree_device_bytes(tree) -> int:
+    """Bytes ONE device holds for the whole tree (per-rank footprint)."""
+    import jax
+
+    return sum(
+        leaf_device_bytes(l) for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def train_memory_report(
+    params, opt_state, grads: Optional[Any] = None
+) -> Dict[str, Any]:
+    """The bench-JSON memory block: global and per-device bytes for each
+    train-state component. ``opt_state_reduction_x`` is global/per-device
+    for the optimizer state — ≈ world under ZeRO weight-update sharding,
+    1.0 replicated."""
+    out: Dict[str, Any] = {
+        "param_bytes": tree_bytes(params),
+        "param_bytes_per_device": tree_device_bytes(params),
+        "opt_state_bytes": tree_bytes(opt_state),
+        "opt_state_bytes_per_device": tree_device_bytes(opt_state),
+    }
+    if grads is not None:
+        out["grad_bytes"] = tree_bytes(grads)
+        out["grad_bytes_per_device"] = tree_device_bytes(grads)
+    per_dev = out["opt_state_bytes_per_device"]
+    out["opt_state_reduction_x"] = round(
+        out["opt_state_bytes"] / per_dev, 3
+    ) if per_dev else 0.0
+    return out

@@ -54,7 +54,8 @@ def _topo(W, hosts=None):
 
 
 def _run_gang(pln, inputs, reduce_kind="sum", average=False,
-              verifiers=None, route="t", join_timeout=60.0):
+              verifiers=None, route="t", join_timeout=60.0,
+              pipeline=1):
     """Execute a plan across W in-process planes (one thread per rank);
     returns (results, errors) keyed by rank."""
     W = pln.world
@@ -70,6 +71,7 @@ def _run_gang(pln, inputs, reduce_kind="sum", average=False,
                 pln, r, inputs[r], planes[r], route=route, timeout=15.0,
                 reduce_kind=reduce_kind, average=average,
                 verifier=verifiers[r] if verifiers else None,
+                pipeline_chunks=pipeline,
             )
         except Exception as e:  # collected for assertions, incl. chaos
             errors[r] = e
@@ -259,6 +261,130 @@ class TestExecutorGangs:
             res[1], np.mean(np.stack(xs).astype(np.float64), axis=0),
             rtol=1e-5, atol=1e-5,
         )
+
+    def test_pipelined_execution_bitwise_matches_plain(self):
+        """SATELLITE (ISSUE 10): chunk pipelining — send of chunk i+1
+        overlapped with the fold of chunk i — is BITWISE identical to
+        the plain walk for every algorithm (fold order within a segment
+        is ascending offset either way)."""
+        for alg, W, hosts in [
+            ("ring", 4, None),
+            ("rhd", 4, None),
+            ("hier", 5, ((0, 1, 2), (3, 4))),
+        ]:
+            t = _topo(W, hosts)
+            n = 37  # padding + an indivisible-by-chunks segment size
+            rng = np.random.default_rng(11)
+            xs = [
+                rng.standard_normal(n).astype(np.float32)
+                for _ in range(W)
+            ]
+            p = schedules.synthesize("all_reduce", alg, W, n, t)
+            a, ea = _run_gang(p, xs, pipeline=1, route=f"pl1{alg}")
+            b, eb = _run_gang(p, xs, pipeline=4, route=f"pl4{alg}")
+            assert not any(ea) and not any(eb), (alg, ea, eb)
+            for r in range(W):
+                assert a[r].tobytes() == b[r].tobytes(), (alg, r)
+
+    def test_pipelined_rounds_fingerprint_chunking(self):
+        """The |pipeN descriptor suffix lands in the verified round
+        fingerprints for pipelined rounds and ONLY those — hier's
+        reduce_any fan-in rounds stay unpipelined (one frame per
+        member) and keep the plain descriptor."""
+
+        class Rec:
+            def __init__(self):
+                self.details = []
+
+            def record(self, seq, op, shape, dtype, detail=""):
+                self.details.append(detail)
+
+        W = 5
+        t = Topology(W, ((0, 1, 2), (3, 4)), "cpu")
+        rng = np.random.default_rng(12)
+        xs = [rng.standard_normal(24).astype(np.float32) for _ in range(W)]
+        p = schedules.synthesize("all_reduce", "hier", W, 24, t)
+        recs = [Rec() for _ in range(W)]
+        _, errs = _run_gang(
+            p, xs, pipeline=3, verifiers=recs, route="plfp"
+        )
+        assert not any(errs), errs
+        # every rank records the identical descriptor sequence
+        assert all(r.details == recs[0].details for r in recs)
+        piped = [d for d in recs[0].details if d.endswith("|pipe3")]
+        plain = [d for d in recs[0].details if not d.endswith("|pipe3")]
+        # cross-host leader ring rounds pipeline; the intra-host
+        # reduce_any fan-in and broadcast-copy rounds are judged by the
+        # reduce_any rule only — fan-in stays plain
+        assert piped, recs[0].details
+        assert any("intra_reduce" in d for d in plain)
+
+    def test_split_chunks_covers_exactly(self):
+        from pytorch_distributed_example_tpu.plan.executor import (
+            split_chunks,
+        )
+
+        for off, length, c in [(0, 10, 4), (7, 3, 8), (5, 1, 4),
+                               (2, 12, 3)]:
+            parts = split_chunks(off, length, c)
+            assert sum(n for _, n in parts) == length
+            assert parts[0][0] == off
+            for (o1, n1), (o2, _) in zip(parts, parts[1:]):
+                assert o1 + n1 == o2
+            assert all(n > 0 for _, n in parts)
+
+    def test_ring_pipe_is_a_plane_candidate_and_cache_drives_it(
+        self, tmp_path, monkeypatch
+    ):
+        """`ring_pipe` rides the probe table as a first-class p2p-plane
+        candidate: absent measurements the structural default stays the
+        plain ring, and a cache row where the pipelined walk measured
+        fastest selects it (plan_for still synthesizes the base ring
+        schedule)."""
+        from pytorch_distributed_example_tpu.plan import probe
+        from pytorch_distributed_example_tpu.plan.planner import (
+            CollectivePlanner,
+        )
+
+        t = _topo(4)
+        pl = CollectivePlanner(
+            t, cache=probe.ProbeCache(str(tmp_path / "pc.json"))
+        )
+        cands = pl.candidates("all_reduce", "sum", "plane")
+        assert "ring_pipe" in cands and cands[0] == "ring"
+        # no timings anywhere -> structural default = plain ring
+        alg, source = pl.choose("all_reduce", 4096, "sum", "plane")
+        assert (alg, source) == ("ring", "default")
+        # a measured row that favors the pipelined walk wins
+        bucket = probe.bucket_bytes(1 << 20)
+        pl2 = CollectivePlanner(
+            t, cache=probe.ProbeCache(str(tmp_path / "pc2.json"))
+        )
+        pl2.cache.update(
+            t.key(), "all_reduce", bucket,
+            {"ring": 2e-3, "rhd": 3e-3, "ring_pipe": 1e-3},
+            plane="plane",
+        )
+        alg, source = pl2.choose("all_reduce", 1 << 20, "sum", "plane")
+        assert (alg, source) == ("ring_pipe", "cache")
+        plan_obj = pl2.plan_for("all_reduce", alg, 1024)
+        assert plan_obj.algorithm == "ring"  # base schedule, piped walk
+        # a PRE-VARIANT cache row (no ring_pipe timing) stays usable:
+        # the measured base winner is kept, not reverted to the
+        # structural default just because a variant has no row yet
+        pl3 = CollectivePlanner(
+            t, cache=probe.ProbeCache(str(tmp_path / "pc3.json"))
+        )
+        pl3.cache.update(
+            t.key(), "all_reduce", bucket,
+            {"ring": 3e-3, "rhd": 1e-3}, plane="plane",
+        )
+        alg, source = pl3.choose("all_reduce", 1 << 20, "sum", "plane")
+        assert (alg, source) == ("rhd", "cache")
+        # and a forced pin accepts the variant name
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring_pipe")
+        alg, source = pl2.choose("all_reduce", 1 << 20, "sum", "plane")
+        assert (alg, source) == ("ring_pipe", "force")
 
     def test_hier_reduce_any_is_bitwise_deterministic(self):
         """Leader folds member contributions in sorted-peer order even
