@@ -268,6 +268,21 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # prefix-sharing paged KV (ISSUE 12): shared-preamble trace
+            # replayed with the radix prefix cache on vs off — >= 3x
+            # TTFT target + pool-bytes/request reduction, token
+            # identity asserted inside the bench
+            "serve_prefix",
+            [sys.executable, "benchmarks/serve_prefix.py"]
+            + (
+                ["--preset", "tiny", "--requests", "12", "--slots", "4",
+                 "--preamble-tokens", "64"]
+                if q
+                else ["--preset", "small", "--bf16"]
+            ),
+            {},
+        ),
+        (
             # tensor-parallel decode goodput scaling 1 -> 2 chips
             # (ISSUE 6, >= 1.7x target on TPU; CPU runs are a virtual-
             # device wiring smoke, not a measurement)

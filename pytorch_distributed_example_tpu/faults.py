@@ -74,6 +74,13 @@ Named injection points wired in this package:
                                                     requeues the half-prefilled
                                                     request, frees its blocks,
                                                     and it replays from seed)
+    serve.prefix_attach                            (before a prefix-cache
+                                                    lookup/attach at admission
+                                                    — fired with zero blocks
+                                                    attached, so a transient
+                                                    fault requeues cleanly and
+                                                    the replay re-attaches the
+                                                    same shared blocks)
     serve.drain                                    (before an elastic drain
                                                     snapshot is cut — fired
                                                     with the engine untouched,
@@ -170,6 +177,7 @@ KNOWN_POINTS = frozenset({
     "checkpoint.write",
     "checkpoint.finalize",
     "serve.admit",
+    "serve.prefix_attach",
     "serve.prefill_chunk",
     "serve.step",
     "serve.drain",

@@ -13,6 +13,15 @@ pool utilization included — exposed over the debug HTTP frontend
 static-batch baseline, paged-vs-dense cache memory per request, chunked
 vs unchunked long-prompt-burst TTFT, and 1→N-chip TP goodput scaling.
 
+Prefix sharing (ISSUE 12): the pool's physical blocks are refcounted
+with copy-on-write divergence (`cache.py`), and a radix prefix index
+(`prefix.py`) maps a new request's longest cached prompt prefix to
+already-filled blocks — admission attaches them by reference and
+prefill starts at the first uncached position, so TTFT and pool bytes
+scale with UNIQUE tokens. Cross-tenant sharing is opt-in per
+`ClassSpec.share_prefix`; `benchmarks/serve_prefix.py` is the
+shared-preamble TTFT/pool-bytes row.
+
 Multi-tenant + elastic (ROADMAP item 5): priority classes with
 weighted admission, class-ordered overload shedding and cross-class
 preemption (`queue.py` / `engine.py` ``classes=``), and drain /
@@ -42,6 +51,7 @@ from .elastic import (  # noqa: F401
 )
 from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
+from .prefix import PrefixIndex  # noqa: F401
 from .queue import (  # noqa: F401
     ClassSpec,
     Completion,

@@ -20,6 +20,24 @@ Two layouts live here:
   write, dequant inside `ops.gather_paged_kv` so attention math stays
   full precision.
 
+  Physical blocks are REFCOUNTED (ISSUE 12): `attach_prefix` lets a
+  slot reference blocks another request already filled (the prefix
+  cache, `serve/prefix.py`), `free()` DECREMENTS instead of releasing
+  (a block returns to the reusable set only when its last reference
+  drops), and writes go copy-on-write — `cow_block(slot, pos)` copies
+  a block (pool K/V AND the int8 scale planes, one jitted
+  gather/scatter per layer tree) before the slot may write into it
+  while it is shared (refcount > 1) or pinned by a prefix-index entry.
+  Shared physical blocks are counted ONCE everywhere (`live_blocks`,
+  `bytes_live`, `pool_utilization`); `bytes_deduplicated` is the pool
+  memory sharing saves vs a no-sharing layout. Blocks whose refcount
+  hits zero while a prefix-index entry still names them move to a
+  CACHED free list: they stay reclaimable (counted in `free_blocks`,
+  handed out LRU after the plain free list drains, invalidating their
+  index entry through `evict_hook`) but keep their content until then,
+  which is what lets a retired request's prompt prefix serve later
+  identical prompts for free.
+
 * `SlotKVCache` — the PR 4 dense per-slot layout, kept as the
   reference/baseline the bench and the parity tests compare against:
   one ``(slots, max_seq_len, kv_heads, head_dim)`` buffer per layer,
@@ -32,7 +50,8 @@ tree functionally — callers own exactly one live version.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -70,6 +89,32 @@ def _write_slot_fn():
     import jax
 
     return jax.jit(land_slot)
+
+
+@functools.lru_cache(maxsize=8)
+def _copy_block_fn():
+    """Jitted whole-block pool copy — the copy-on-write data mover.
+
+    Copies physical block `src` onto physical block `dst` across EVERY
+    pool leaf (K, V, and — quantized pools — the `k_scale`/`v_scale`
+    planes ride the same tree_map, so a CoW'd int8 block needs no
+    requantization: its per-(token, kv-head) scales copy bit-for-bit
+    alongside the payload). The tree is DONATED, matching the serve
+    programs' in-place-update discipline; `src`/`dst` ride in as int32
+    scalars so the program compiles once per tree shape. Under a TP
+    mesh the pool leaves carry KV-head shardings and GSPMD keeps the
+    copy local per shard (block axis is unsharded)."""
+    import jax
+
+    def copy(tree, src, dst):
+        def leaf(buf):
+            if buf.ndim == 0:
+                return buf
+            return buf.at[dst].set(buf[src])
+
+        return jax.tree_util.tree_map(leaf, tree)
+
+    return jax.jit(copy, donate_argnums=(0,))
 
 
 class SlotKVCache:
@@ -205,6 +250,22 @@ class PagedKVCache:
     is cheaper than donated-device choreography); `lengths` mirrors
     per-slot depth for introspection. Blocks return to the free list at
     `free()` (retire/preempt) in FIFO reuse order.
+
+    Refcounts + copy-on-write (ISSUE 12): every physical block carries
+    a reference count. `ensure_blocks` hands out refcount-1 blocks;
+    `attach_prefix` lets a slot adopt already-filled blocks (prefix
+    sharing — refcount incremented, content untouched); `free()`
+    DECREMENTS, so a shared block outlives any single holder and is
+    counted once in every byte/utilization figure. A slot about to
+    write into a block that is shared (refcount > 1) or pinned by a
+    prefix-index entry must call `cow_block` first: the block is copied
+    to a fresh one (K/V and scale planes), the slot's table is
+    repointed, and the original keeps serving its other holders — so
+    partial-boundary divergence costs exactly one block copy. Blocks
+    whose refcount hits 0 while still named by a prefix index park on a
+    CACHED free list: reclaimable (LRU, after the plain free list,
+    invalidating their index entry via `evict_hook`) but content-
+    preserving until actually reused.
     """
 
     def __init__(
@@ -249,6 +310,15 @@ class PagedKVCache:
         self._free_slots: List[int] = list(range(slots))
         self._free_blocks: List[int] = list(range(num_blocks))
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        # prefix-sharing plane: per-block refcounts, the set of blocks a
+        # prefix index currently names, the refcount-0-but-still-indexed
+        # cached list (LRU reclaim order), the index's invalidation hook
+        # (PrefixIndex wires itself in), and the CoW copy counter
+        self._refcount = np.zeros((num_blocks,), np.int32)
+        self._indexed: set = set()
+        self._cached_blocks: "OrderedDict[int, None]" = OrderedDict()
+        self.evict_hook: Optional[Callable[[int], None]] = None
+        self.cow_copies = 0
 
     # -- slot lifecycle ----------------------------------------------------
     def allocate(self) -> Optional[int]:
@@ -261,12 +331,18 @@ class PagedKVCache:
         return s
 
     def free(self, slot: int) -> int:
-        """Retire a slot: return its blocks to the pool and invalidate
-        its table row. Returns the number of blocks freed."""
+        """Retire a slot: DECREMENT each of its blocks' refcounts and
+        invalidate its table row. A block returns to the reusable pool
+        only when its last reference drops (shared prefix blocks stay
+        live for their other holders — the class-aware eviction path
+        therefore frees a shared-prefix victim without touching the
+        prefix). Returns the number of blocks whose refcount hit zero
+        (= blocks actually reclaimable again)."""
         if not self._in_use[slot]:
             raise ValueError(f"slot {slot} is not allocated")
-        n = len(self._slot_blocks[slot])
-        self._free_blocks.extend(self._slot_blocks[slot])
+        n = 0
+        for b in self._slot_blocks[slot]:
+            n += self._decref(b)
         self._slot_blocks[slot] = []
         self.block_tables[slot, :] = self.invalid_block
         self._in_use[slot] = False
@@ -290,8 +366,9 @@ class PagedKVCache:
     def ensure_blocks(self, slot: int, upto_pos: int) -> bool:
         """Grow `slot`'s table so position `upto_pos` is writable
         (allocate-on-write). All-or-nothing: returns False — allocating
-        NOTHING — when the free list can't cover the growth; the engine
-        turns that into backpressure or preemption."""
+        NOTHING — when the reclaimable set (plain free list + cached
+        prefix blocks) can't cover the growth; the engine turns that
+        into backpressure or preemption."""
         if not self._in_use[slot]:
             raise ValueError(f"slot {slot} is not allocated")
         if not 0 <= upto_pos < self.blocks_per_seq * self.block_size:
@@ -303,12 +380,139 @@ class PagedKVCache:
         need = upto_pos // self.block_size + 1 - have
         if need <= 0:
             return True
-        if need > len(self._free_blocks):
+        if need > self.free_blocks:
             return False
         for j in range(have, have + need):
-            b = self._free_blocks.pop(0)
+            b = self._take_block()
+            self._refcount[b] = 1
             self._slot_blocks[slot].append(b)
             self.block_tables[slot, j] = b
+        return True
+
+    # -- refcount plumbing -------------------------------------------------
+    def _take_block(self) -> int:
+        """Pop a reusable physical block: plain free list first (FIFO —
+        the PR 6 reuse order, unchanged when no prefix index runs),
+        then the CACHED list oldest-freed-first, invalidating the
+        evicted block's prefix-index entry (and, through the hook, its
+        whole subtree — a child prefix is meaningless once its parent's
+        content is gone). Caller sets the refcount."""
+        if self._free_blocks:
+            return self._free_blocks.pop(0)
+        b, _ = self._cached_blocks.popitem(last=False)
+        if self.evict_hook is not None:
+            self.evict_hook(b)
+        # the hook deindexed b's subtree; b itself was already popped
+        self._indexed.discard(b)
+        return b
+
+    def _ref_block(self, b: int) -> None:
+        """Add one reference to `b`; a reclaimable (refcount-0) block
+        leaves the free set again — the cached list for indexed blocks
+        (the only attach source in production), the plain free list
+        defensively."""
+        if self._refcount[b] == 0:
+            if b in self._cached_blocks:
+                del self._cached_blocks[b]
+            elif b in self._free_blocks:
+                self._free_blocks.remove(b)
+        self._refcount[b] += 1
+
+    def _decref(self, b: int) -> int:
+        """Drop one reference; returns 1 when the block became
+        reclaimable (refcount hit 0 — parked cached when a prefix index
+        still names it, plain free otherwise)."""
+        self._refcount[b] -= 1
+        if self._refcount[b] > 0:
+            return 0
+        if b in self._indexed:
+            self._cached_blocks[b] = None
+        else:
+            self._free_blocks.append(b)
+        return 1
+
+    def _deindex(self, b: int) -> None:
+        """Prefix-index callback: entry naming `b` is gone. A cached
+        block demotes to the plain free list; a still-referenced block
+        just loses its write protection."""
+        self._indexed.discard(b)
+        if b in self._cached_blocks:
+            del self._cached_blocks[b]
+            self._free_blocks.append(b)
+
+    def mark_indexed(self, b: int) -> None:
+        """Prefix-index callback: an index node now names `b` — its
+        content must survive refcount 0 (cached, reclaim-last) and any
+        write into it must copy first (`cow_block`)."""
+        self._indexed.add(b)
+
+    def refcount(self, b: int) -> int:
+        return int(self._refcount[b])
+
+    def attach_prefix(self, slot: int, blocks: Sequence[int]) -> None:
+        """Adopt already-filled `blocks` as the slot's leading logical
+        blocks (prefix-cache hit): each gains a reference; content and
+        any other holders are untouched. The slot must be freshly
+        allocated (no blocks yet) — admission attaches before the first
+        prefill chunk."""
+        if not self._in_use[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        if self._slot_blocks[slot]:
+            raise ValueError(
+                f"slot {slot} already holds blocks; prefix attach must "
+                f"precede the first write"
+            )
+        for j, b in enumerate(blocks):
+            self._ref_block(b)
+            self._slot_blocks[slot].append(b)
+            self.block_tables[slot, j] = b
+
+    def needs_cow(self, slot: int, pos: int) -> bool:
+        """Would a write at position `pos` hit a block the slot may not
+        mutate in place (shared, or pinned by a prefix index)?"""
+        lb = pos // self.block_size
+        if lb >= len(self._slot_blocks[slot]):
+            return False
+        b = self._slot_blocks[slot][lb]
+        return self._refcount[b] > 1 or b in self._indexed
+
+    def cow_block(self, slot: int, pos: int) -> bool:
+        """Copy-on-write: make the block holding position `pos` PRIVATE
+        to `slot` before a write lands in it. No-op when the block is
+        already exclusive (or unallocated — growth is `ensure_blocks`'
+        job). Divergence inside a shared block copies ONLY that block:
+        pool K/V and the quantized scale planes move in one jitted
+        donated program, the slot's table repoints, and the original
+        keeps its other holders / index entry. When the pool is dry and
+        the only protection is an index entry (refcount 1), the entry
+        is sacrificed instead of copying — the slot then owns the block
+        outright. Returns False when a copy is required but no block is
+        reclaimable (the engine's preemption signal)."""
+        lb = pos // self.block_size
+        if lb >= len(self._slot_blocks[slot]):
+            return True
+        b = self._slot_blocks[slot][lb]
+        shared = self._refcount[b] > 1
+        if not shared and b not in self._indexed:
+            return True
+        if not shared and self.free_blocks == 0:
+            # index-only protection + dry pool: drop the entry (and its
+            # subtree) rather than fail — cheaper than a preemption
+            if self.evict_hook is not None:
+                self.evict_hook(b)
+            self._indexed.discard(b)
+            return True
+        if self.free_blocks == 0:
+            return False
+        new = self._take_block()
+        self._refcount[new] = 1
+        self.tree = _copy_block_fn()(
+            self.tree, np.int32(b), np.int32(new)
+        )
+        self._slot_blocks[slot][lb] = new
+        self.block_tables[slot, lb] = new
+        self._decref(b)
+        self.cow_copies += 1
         return True
 
     # -- introspection -----------------------------------------------------
@@ -322,11 +526,41 @@ class PagedKVCache:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free_blocks)
+        """Reclaimable physical blocks: the plain free list PLUS cached
+        prefix blocks (refcount 0, still indexed — evictable on
+        demand). Backpressure and capacity math treat both as free."""
+        return len(self._free_blocks) + len(self._cached_blocks)
 
     @property
     def live_blocks(self) -> int:
-        return self.num_blocks - len(self._free_blocks)
+        """Physical blocks some slot references — each SHARED block
+        counts ONCE (the whole point of prefix sharing: pool bytes
+        track unique content, not per-request logical footprint)."""
+        return self.num_blocks - self.free_blocks
+
+    @property
+    def cached_free_blocks(self) -> int:
+        """Refcount-0 blocks kept alive only for the prefix index."""
+        return len(self._cached_blocks)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Physical blocks referenced by more than one slot."""
+        return int((self._refcount > 1).sum())
+
+    @property
+    def total_block_refs(self) -> int:
+        """Sum of slot references — what the pool would hold with NO
+        sharing; `total_block_refs - live-referenced blocks` is the
+        dedup saving in blocks."""
+        return int(self._refcount.sum())
+
+    @property
+    def bytes_deduplicated(self) -> int:
+        """Pool bytes sharing saves right now vs a copy-per-reference
+        layout: (refcount - 1) summed over shared blocks, in bytes."""
+        extra = int(np.maximum(self._refcount - 1, 0).sum())
+        return extra * self.bytes_per_block
 
     @property
     def pool_utilization(self) -> float:
@@ -388,11 +622,21 @@ class PagedKVCache:
     def slot_blocks(self, slot: int) -> List[int]:
         return list(self._slot_blocks[slot])
 
+    def exclusive_blocks(self, slot: int) -> int:
+        """Blocks only `slot` references — what evicting it alone is
+        guaranteed to reclaim (shared prefix blocks survive their
+        holders, so eviction feasibility math must not count them)."""
+        return sum(
+            1 for b in self._slot_blocks[slot] if self._refcount[b] == 1
+        )
+
     def __repr__(self) -> str:
         return (
             f"PagedKVCache(slots={self.slots}, "
             f"blocks={self.live_blocks}/{self.num_blocks}, "
             f"block_size={self.block_size}, "
             f"active={int(self._in_use.sum())}, "
+            f"shared={self.shared_blocks}, "
+            f"cached={self.cached_free_blocks}, "
             f"wire={self.wire_dtype})"
         )

@@ -146,7 +146,16 @@ def paged_programs(model, temperature: float, top_k: Optional[int]):
       absolute offset `start`; returns (tree', logits (C, V)). Compiles
       once per CHUNK length C: with `prefill_chunk_tokens` set that is
       ONE program for every prompt; unchunked it is one per bucket,
-      exactly like PR 4.
+      exactly like PR 4. `start` is NONZERO both for later chunks of a
+      long prompt and for the FIRST chunk after a prefix-cache attach
+      (ISSUE 12): the engine hands the program a table row whose
+      leading blocks hold another request's identical prompt prefix,
+      and the chunk begins at the first uncached position — same RoPE
+      absolute-position math, same causal mask over the row's logical
+      layout, so a shared-prefix prefill is bit-identical to a cold
+      one that happened to start there. Writes below `start` never
+      occur (the engine copy-on-writes the boundary block before
+      dispatch when it is shared).
     * ``first_token(chunk_logits, end, seed)`` — sample the request's
       first token from the TRUE prompt-end logits row (`end` indexes
       within the final chunk, so padding never leaks) with the

@@ -41,14 +41,33 @@ inserting the one all-reduce per block pair that Megatron hand-codes.
 Slot bookkeeping and block tables stay host-side and identical on
 every chip.
 
+Prefix sharing (``prefix_cache=True``, ISSUE 12): admission looks the
+request's prompt up in a radix prefix index (`serve/prefix.py`) and
+ATTACHES the longest cached prefix's blocks (refcounted, `serve/
+cache.py::attach_prefix`) so chunked prefill starts at the first
+uncached position — skipping both the prefill compute and the pool
+writes for every hit. Prompt blocks are indexed at prefill completion
+(pristine — decoded tokens are never indexed); divergence inside a
+shared or indexed block copies exactly that block (copy-on-write)
+before the write. Sharing crosses TENANTS only when the request's
+`ClassSpec.share_prefix` opts in (default off — each tenant gets a
+private scope); pool-pressure and class-aware eviction only ever
+DECREMENT refcounts, so a shared prefix survives its victims, and
+unreferenced index entries are reclaimed LRU behind the plain free
+list. Outputs are token-exact with sharing on or off: a cached block
+holds exactly the K/V the attaching request would have recomputed
+(same tokens, same absolute positions, same params).
+
 Fault surface: `serve.admit` before each admission, `serve.
-prefill_chunk` before each prompt chunk, `serve.step` before each
-decode batch, `serve.drain` before a drain snapshot (all in
-`faults.KNOWN_POINTS`). Transient faults requeue the affected requests
-at the queue head and the engine carries on; because each request
-replays from its own seed, a greedy request's output is token-identical
-across any number of mid-stream requeues (`tests/test_serve.py` /
-`tests/test_serve_paged.py` chaos cases).
+prefix_attach` before a prefix-cache attach, `serve.prefill_chunk`
+before each prompt chunk, `serve.step` before each decode batch,
+`serve.drain` before a drain snapshot (all in `faults.KNOWN_POINTS`).
+Transient faults requeue the affected requests at the queue head and
+the engine carries on; because each request replays from its own seed,
+a greedy request's output is token-identical across any number of
+mid-stream requeues (`tests/test_serve.py` / `tests/test_serve_paged.py`
+chaos cases), and a replayed request re-attaches its cached prefix
+deterministically (`tests/test_serve_prefix.py`).
 
 Multi-tenant SLO-aware admission (``classes=``): requests carry a
 tenant id and a priority class; the queue admits by smooth weighted
@@ -112,8 +131,9 @@ _TRANSIENT = (ConnectionResetError, faults.FaultTimeout)
 
 @dataclass
 class _Prefill:
-    """A slot mid-prefill: `pos` is the next prompt position to chunk;
-    the request is not decoding (its lane stays parked) until the last
+    """A slot mid-prefill: `pos` is the next prompt position to chunk
+    (nonzero when a prefix-cache attach covered the prompt head); the
+    request is not decoding (its lane stays parked) until the last
     chunk lands and `attach` seeds its state lanes."""
 
     req: Request
@@ -142,6 +162,7 @@ class ServeEngine:
         conservative_admission: bool = False,
         classes: Optional[Dict[str, ClassSpec]] = None,
         class_preemption: bool = True,
+        prefix_cache: bool = False,
     ):
         self.model = model
         self.params = params["params"] if "params" in params else params
@@ -154,6 +175,14 @@ class ServeEngine:
             model, slots, num_blocks=pool_blocks, block_size=block_size,
             quantized=kv_quant,
         )
+        # prefix sharing: radix index over the refcounted pool — OPT-IN
+        # (off keeps PR 6 pool semantics and accounting bit-for-bit)
+        if prefix_cache:
+            from .prefix import PrefixIndex
+
+            self.prefix = PrefixIndex(self.cache)
+        else:
+            self.prefix = None
         # multi-tenant classes: weighted admission + class-ordered shed
         # in the queue; cross-class preemption here. None = the single
         # default class (PR 4 FIFO semantics, bit-for-bit).
@@ -386,10 +415,16 @@ class ServeEngine:
         kill worse-class work (possibly work admitted moments earlier),
         churning requeues without any gold progress."""
         head_len = len(head.prompt)
+        # first-chunk sizing ignores a possible prefix-cache hit (the
+        # match runs after the fire points, post-acquisition): a hit
+        # only ever needs FEWER fresh blocks, so the gate errs toward
+        # backpressure, never toward overcommit
         need = self.cache.blocks_for(min(self._chunk_len(head_len), head_len))
         victims = self._class_victims(head)
         if need > self.cache.free_blocks + sum(
-            len(self.cache.slot_blocks(s)) for s in victims
+            # only a victim's EXCLUSIVE blocks are guaranteed back —
+            # shared prefix blocks outlive the eviction
+            self.cache.exclusive_blocks(s) for s in victims
         ):
             return "blocked"  # pool backpressure: wait for retires
         if self.conservative_admission:
@@ -433,12 +468,46 @@ class ServeEngine:
             self.queue.requeue_front(req)
             self.metrics.record_requeue()
             return "stop"
+        pos0 = 0
+        if self.prefix is not None:
+            try:
+                faults.fire("serve.prefix_attach", rid=req.rid)
+            except _TRANSIENT:
+                # transient attach fault: nothing was attached yet (the
+                # slot holds zero blocks), so freeing it is clean; the
+                # replay re-matches the index and attaches the SAME
+                # shared blocks deterministically
+                self.cache.free(slot)
+                req.requeues += 1
+                self.queue.requeue_front(req)
+                self.metrics.record_requeue()
+                return "stop"
+            # hit/miss/reuse accounting lives in the INDEX (the next
+            # record_pool snapshots its stats() into the metrics)
+            blocks, matched = self.prefix.match(
+                self._prefix_scope(req), req.prompt.tolist()
+            )
+            if matched > 0:
+                self.cache.attach_prefix(slot, blocks)
+                pos0 = matched
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
-        self._prefilling[slot] = _Prefill(req)
+        self._prefilling[slot] = _Prefill(req, pos=pos0)
         self._reserved += self._worst_blocks(req)
         self.metrics.record_admit()
         return "admitted"
+
+    def _prefix_scope(self, req: Request):
+        """The sharing boundary for `req`'s prefix-cache entries: a
+        PRIVATE per-tenant scope unless the request's class opts into
+        cross-tenant sharing (`ClassSpec.share_prefix` — both sides of
+        any cross-tenant hit opted in by construction, since matching
+        only ever happens within one scope)."""
+        if self.classes is not None:
+            spec = self.classes.get(req.klass)
+            if spec is not None and spec.share_prefix:
+                return "*"
+        return ("tenant", req.tenant)
 
     def _class_victims(self, head: Request) -> List[int]:
         """Slots holding in-flight work of a class STRICTLY below
@@ -517,7 +586,10 @@ class ServeEngine:
             req = pf.req
             L = len(req.prompt)
             if budget is None:
-                C = bucket_for(L, self.buckets)
+                # bucket over the REMAINING prompt: a prefix-cache
+                # attach starts the (single, unchunked) program at the
+                # first uncached position, not at 0
+                C = bucket_for(L - pf.pos, self.buckets)
             else:
                 # program length this tick: the bucket covering what the
                 # remaining budget can spend, capped at the budget (so
@@ -530,6 +602,8 @@ class ServeEngine:
             end = min(pf.pos + C, L)
             if not self._ensure_or_preempt(slot, end - 1):
                 continue  # the prefilling request itself got evicted
+            if not self._cow_or_preempt(slot, pf.pos):
+                continue  # ditto, while claiming a copy-on-write block
             try:
                 faults.fire("serve.prefill_chunk", rid=req.rid, pos=pf.pos)
             except _TRANSIENT:
@@ -571,6 +645,17 @@ class ServeEngine:
                 key,
             )
             self.cache.lengths[slot] = L  # host mirror for introspection
+            if self.prefix is not None:
+                # index the prompt's blocks NOW, before the first decode
+                # write lands — entries hold PROMPT K/V only, so decoded
+                # tokens can never be served to another request (the
+                # slot's own next write into its partial tail block
+                # copy-on-writes it, leaving the indexed original
+                # pristine)
+                self.prefix.insert(
+                    self._prefix_scope(req), req.prompt.tolist(),
+                    self.cache.slot_blocks(slot),
+                )
             del self._prefilling[slot]
             self._decoding.add(slot)
             self._slot_tokens[slot] = [first]
@@ -591,32 +676,54 @@ class ServeEngine:
                 return  # budget spent: yield to decode
 
     # -- pool pressure -----------------------------------------------------
+    def _preempt_for_pool(self, slot: int) -> bool:
+        """ONE pool-pressure eviction: the WORST-CLASS then youngest
+        active request loses its slot and blocks (single-class engines:
+        plain youngest-first, the PR 6 policy). Returns False when the
+        victim was `slot` itself — the caller's own request got evicted
+        and its retry loop must stop. The ONE copy of the pressure
+        policy: block growth and copy-on-write both retry through it,
+        so they can never diverge."""
+        victims = [
+            s
+            for s in range(self.cache.slots)
+            if self._slot_req[s] is not None
+        ]
+        victim = max(
+            victims,
+            key=lambda s: (
+                self.classes[self._slot_req[s].klass].priority
+                if self.classes
+                else 0,
+                self._slot_req[s].arrival_time,
+            ),
+        )
+        klass = self._slot_req[victim].klass
+        self._evict(victim, requeue_counter=False)
+        self.metrics.record_preempt(klass=klass)
+        return victim != slot
+
     def _ensure_or_preempt(self, slot: int, upto_pos: int) -> bool:
-        """Grow `slot`'s block table to cover `upto_pos`, evicting the
-        WORST-CLASS then youngest active request while the pool is dry
-        (single-class engines: plain youngest-first, the PR 6 policy).
-        Returns False when the grower itself got evicted. Deadlock-free:
-        submit() guarantees any single request's worst case fits the
-        pool, so the oldest request of the best class always wins."""
+        """Grow `slot`'s block table to cover `upto_pos`, evicting via
+        `_preempt_for_pool` while the pool is dry. Returns False when
+        the grower itself got evicted. Deadlock-free: submit()
+        guarantees any single request's worst case fits the pool, so
+        the oldest request of the best class always wins."""
         while not self.cache.ensure_blocks(slot, upto_pos):
-            victims = [
-                s
-                for s in range(self.cache.slots)
-                if self._slot_req[s] is not None
-            ]
-            victim = max(
-                victims,
-                key=lambda s: (
-                    self.classes[self._slot_req[s].klass].priority
-                    if self.classes
-                    else 0,
-                    self._slot_req[s].arrival_time,
-                ),
-            )
-            klass = self._slot_req[victim].klass
-            self._evict(victim, requeue_counter=False)
-            self.metrics.record_preempt(klass=klass)
-            if victim == slot:
+            if not self._preempt_for_pool(slot):
+                return False
+        return True
+
+    def _cow_or_preempt(self, slot: int, pos: int) -> bool:
+        """Copy-on-write the block a write at `pos` would land in while
+        it is shared or index-pinned, evicting via `_preempt_for_pool`
+        while the pool cannot spare the copy's block. Returns False
+        when the writer itself got evicted. Almost always a no-op: only
+        the FIRST write past a shared partial boundary (or into the
+        slot's own freshly indexed tail) copies; the copy is private
+        from then on."""
+        while not self.cache.cow_block(slot, pos):
+            if not self._preempt_for_pool(slot):
                 return False
         return True
 
@@ -660,6 +767,11 @@ class ServeEngine:
             wire_dtype=self.cache.wire_dtype,
             scale_bytes_per_block=self.cache.scale_bytes_per_block,
             effective_slots=self.cache.effective_slots,
+            shared_blocks=self.cache.shared_blocks,
+            cached_free_blocks=self.cache.cached_free_blocks,
+            cow_copies=self.cache.cow_copies,
+            bytes_deduplicated=self.cache.bytes_deduplicated,
+            prefix_stats=self.prefix.stats() if self.prefix else None,
         )
         while True:
             self._prefill_tick()
@@ -683,7 +795,11 @@ class ServeEngine:
         for s in sorted(self._decoding):
             if s not in self._decoding:  # evicted by an earlier growth
                 continue
-            self._ensure_or_preempt(s, int(self.cache.lengths[s]))
+            if not self._ensure_or_preempt(s, int(self.cache.lengths[s])):
+                continue
+            # first decode write past a shared/indexed prefix boundary
+            # must own a private copy of that block (CoW)
+            self._cow_or_preempt(s, int(self.cache.lengths[s]))
         active = sorted(self._decoding)
         if not active:
             return bool(self._prefilling) or bool(self.queue)

@@ -18,6 +18,11 @@ low class absorbs the sheds) and a RECOVERY block: every elastic
 restore records how long the serving plane was dark (drain/death →
 first token on the re-formed gang), how many requests the checkpoint
 carried back, and how many already-emitted tokens had to replay.
+
+The PREFIX_CACHE block (ISSUE 12) is the sharing evidence: hit rate
+and prefix tokens reused (prefill compute + pool writes skipped),
+shared / copy-on-write-copied block counts, and pool bytes
+deduplicated vs a no-sharing layout (current gauge + peak).
 """
 
 from __future__ import annotations
@@ -88,6 +93,18 @@ class ServeMetrics:
         self.cache_wire_dtype = ""  # pool storage dtype (int8 when quantized)
         self.scale_bytes_per_block = 0  # quantized pools: scale-plane bytes
         self.effective_slots = 0  # worst-case requests the pool can hold
+        # prefix-cache plane (ISSUE 12): attach counters accumulate,
+        # block-level figures are per-step gauges from the pool
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
+        self.prefix_blocks_attached = 0
+        self.prefix_shared_blocks = 0  # gauge: blocks refcounted > 1
+        self.prefix_cached_blocks = 0  # gauge: refcount-0 index-kept blocks
+        self.prefix_index_nodes = 0  # gauge: radix entries
+        self.cow_copies = 0  # cumulative copy-on-write block copies
+        self.bytes_deduplicated = 0  # gauge: pool bytes sharing saves now
+        self.peak_bytes_deduplicated = 0
         self.peak_slots_active = 0  # max concurrent in-flight requests seen
         self._pool_util_sum = 0.0
         self._pool_samples = 0
@@ -206,6 +223,11 @@ class ServeMetrics:
         wire_dtype: str = "",
         scale_bytes_per_block: int = 0,
         effective_slots: int = 0,
+        shared_blocks: int = 0,
+        cached_free_blocks: int = 0,
+        cow_copies: int = 0,
+        bytes_deduplicated: int = 0,
+        prefix_stats: Optional[Dict] = None,
     ) -> None:
         """Per-step paged-pool observation. Gauges keep the LAST value;
         utilization and bytes-per-live-request also accumulate a
@@ -213,7 +235,13 @@ class ServeMetrics:
         so idle steps don't dilute the memory claim). `wire_dtype` /
         `scale_bytes_per_block` / `effective_slots` describe the pool's
         storage format (int8 pools report their scale-plane overhead
-        and the capacity-in-worst-case-requests figure)."""
+        and the capacity-in-worst-case-requests figure). The prefix-
+        sharing figures land on the `/serve` prefix_cache block:
+        `shared_blocks`/`cached_free_blocks`/`bytes_deduplicated`
+        gauges plus the cumulative `cow_copies` come from the cache,
+        and `prefix_stats` is `PrefixIndex.stats()` verbatim — the
+        index is the ONE place hit/miss/reuse counting lives, so the
+        two surfaces can never drift."""
         with self._lock:
             self.pool_blocks_live = blocks_live
             self.pool_blocks_total = blocks_total
@@ -222,6 +250,23 @@ class ServeMetrics:
             self.cache_wire_dtype = wire_dtype
             self.scale_bytes_per_block = scale_bytes_per_block
             self.effective_slots = effective_slots
+            self.prefix_shared_blocks = shared_blocks
+            self.prefix_cached_blocks = cached_free_blocks
+            self.cow_copies = cow_copies
+            self.bytes_deduplicated = bytes_deduplicated
+            self.peak_bytes_deduplicated = max(
+                self.peak_bytes_deduplicated, bytes_deduplicated
+            )
+            if prefix_stats is not None:
+                self.prefix_hits = prefix_stats["hits"]
+                self.prefix_misses = prefix_stats["misses"]
+                self.prefix_tokens_reused = prefix_stats[
+                    "prefix_tokens_reused"
+                ]
+                self.prefix_blocks_attached = prefix_stats[
+                    "blocks_attached"
+                ]
+                self.prefix_index_nodes = prefix_stats["nodes"]
             if blocks_total:
                 self._pool_util_sum += blocks_live / blocks_total
                 self._pool_samples += 1
@@ -374,6 +419,29 @@ class ServeMetrics:
                         self.scale_bytes_per_block * self.pool_blocks_total
                     ),
                     "effective_slots": self.effective_slots,
+                },
+                # prefix sharing (ISSUE 12): hit rate + tokens whose
+                # prefill compute/pool writes were skipped, block-level
+                # sharing gauges, CoW copies, and the pool bytes
+                # deduplicated vs a no-sharing layout
+                "prefix_cache": {
+                    "hits": self.prefix_hits,
+                    "misses": self.prefix_misses,
+                    "hit_rate": round(
+                        self.prefix_hits
+                        / (self.prefix_hits + self.prefix_misses),
+                        4,
+                    ) if (self.prefix_hits + self.prefix_misses) else 0.0,
+                    "prefix_tokens_reused": self.prefix_tokens_reused,
+                    "blocks_attached": self.prefix_blocks_attached,
+                    "shared_blocks": self.prefix_shared_blocks,
+                    "cached_blocks": self.prefix_cached_blocks,
+                    "index_nodes": self.prefix_index_nodes,
+                    "cow_copies": self.cow_copies,
+                    "bytes_deduplicated": self.bytes_deduplicated,
+                    "peak_bytes_deduplicated": (
+                        self.peak_bytes_deduplicated
+                    ),
                 },
             }
         snap["goodput_tokens_per_sec"] = round(

@@ -76,11 +76,18 @@ class ClassSpec:
     important — sheds last, preempts first); `weight` is the SWRR
     admission share; `ttft_slo_s` is the class's TTFT objective,
     reported as SLO attainment in the metrics (advisory — admission
-    is driven by priority/weight, not by the target)."""
+    is driven by priority/weight, not by the target). `share_prefix`
+    opts the class's requests into the CROSS-TENANT prefix-cache scope
+    (default off: a tenant's cached prompt prefixes serve only its own
+    later requests; on, requests share one global scope with every
+    other opted-in class — see `ServeEngine._prefix_scope`. Either
+    way, only PROMPT blocks are ever indexed, so decoded tokens cannot
+    leak across tenants)."""
 
     priority: int
     weight: int = 1
     ttft_slo_s: Optional[float] = None
+    share_prefix: bool = False
 
     def __post_init__(self):
         if self.weight < 1:

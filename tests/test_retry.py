@@ -2,6 +2,7 @@
 the DistError taxonomy, and the store client's retry-under-faults
 behavior the acceptance criteria pin."""
 
+import select
 import time
 
 import pytest
@@ -197,8 +198,17 @@ class TestStoreRetryUnderFaults:
             state = {"armed": True}
 
             def lossy(sock, n):
-                if state["armed"]:
+                # fire ONLY on the CLIENT's read of the response: the
+                # in-process daemon thread shares this module-level
+                # helper, and tripping its request read instead would
+                # kill the increment BEFORE it applied (the loss must
+                # hit the response, per the docstring). Waiting for the
+                # response bytes to be buffered first also pins "the
+                # daemon DID apply" deterministically under any
+                # machine load.
+                if state["armed"] and sock is m._sock:
                     state["armed"] = False
+                    select.select([sock], [], [], 2.0)
                     raise ConnectionResetError("response lost")
                 return real(sock, n)
 
