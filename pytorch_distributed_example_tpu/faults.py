@@ -130,6 +130,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from . import traceguard
 from .types import DistError
 
 __all__ = [
@@ -420,6 +421,11 @@ def fire(point: str, rank: Optional[int] = None, **ctx) -> Optional[FaultRule]:
     implement. Returns None when nothing fires — the overwhelmingly
     common case costs one None check plus (with a plan installed) one
     lock acquisition; with no plan it is a single global read."""
+    # TDX_TRACE_GUARD: every injection point is a host-side effect, and
+    # every blocking store/rendezvous/dispatch op fires through here —
+    # one check covers the whole R011 surface with the op's own name.
+    # The raw point string keeps the no-guard fast path allocation-free.
+    traceguard.check(point)
     plan = (
         _plan
         if _plan_loaded and _plan_error is None

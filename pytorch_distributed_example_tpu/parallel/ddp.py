@@ -537,6 +537,9 @@ def make_ddp_train_step(
         # below the world-x redundancy the sharded update removes; the
         # unsharded path keeps full donation as before.
         donate = (0, 2) if zero_update else (0, 1, 2)
+        donate = zero.assert_donation_contract(
+            donate, sharded_opt_state=zero_update
+        )
         return jax.jit(mapped, donate_argnums=donate)
 
     jitted = None if zero_update else _build_jitted(P())

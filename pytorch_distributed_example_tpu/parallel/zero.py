@@ -167,3 +167,40 @@ def place_sharded(tree, mesh, axis: str):
     return jax.tree_util.tree_map(
         lambda l, s: jax.device_put(l, NamedSharding(jmesh, s)), tree, specs
     )
+
+
+# The shard_map-ed local train step's signature is
+# (params, opt_state, hook_state, xs, ys, rngs): the sharded optimizer
+# state rides at position 1 in EVERY variant.
+OPT_STATE_ARGNUM = 1
+
+
+def assert_donation_contract(
+    donate_argnums, *, sharded_opt_state: bool,
+    opt_state_argnum: int = OPT_STATE_ARGNUM,
+):
+    """The ZeRO donation contract, enforced where donate_argnums is built.
+
+    PR 10 bisected an XLA:CPU heap corruption to donating the
+    dim-0-sharded optimizer state through the persistent compilation
+    cache: deserialized executables mis-handle the in-place aliasing of
+    the sharded buffers, so the sharded state must round-trip the step
+    UNDONATED (cost: one transient 1/W-sized copy per step). distlint
+    R012 polices the read-after-donate half of that contract statically;
+    this assertion closes the drift half — a future edit that silently
+    re-admits the opt-state argnum into the donation set fails HERE, as
+    a named error plus a unit test, instead of as a heap-corruption
+    bisect.
+
+    Returns the validated tuple so call sites can write
+    ``donate = assert_donation_contract(donate, ...)``."""
+    donate = tuple(donate_argnums)
+    if sharded_opt_state and opt_state_argnum in donate:
+        raise ValueError(
+            f"zero: donate_argnums {donate} includes the dim-0-sharded "
+            f"optimizer state (arg {opt_state_argnum}); donating the "
+            "sharded state corrupts the XLA:CPU heap through the "
+            "persistent compilation cache (PR 10 bisect) — keep it out "
+            "of the donation set"
+        )
+    return donate

@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from . import faults
+from . import faults, traceguard
 from .types import DistStoreError, DistTimeoutError
 from .utils.retry import RetryPolicy, call_with_retry
 
@@ -117,6 +117,9 @@ class HashStore(Store):
             self._cv.notify_all()
 
     def get(self, key):
+        # the one blocking client op with no faults.fire choke point —
+        # the trace guard must name it here (TDX_TRACE_GUARD)
+        traceguard.check("store.get")
         deadline = time.monotonic() + self.timeout
         with self._cv:
             while key not in self._data:
@@ -207,6 +210,7 @@ class FileStore(Store):
         self._append(key, _to_bytes(value))
 
     def get(self, key):
+        traceguard.check("store.get")
         deadline = time.monotonic() + self.timeout
         while True:
             data = self._replay()

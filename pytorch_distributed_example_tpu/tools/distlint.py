@@ -82,6 +82,50 @@ R010  collective inside a loop whose trip count depends on rank-local
       of a rank-derived value, or a while-test over rank-local state):
       ranks iterating different counts issue different schedules.
 
+Since PR 13 the analyzer also models the repo's SECOND execution regime:
+jitted / shard_map-traced programs with buffer donation. A third effect
+dimension — **traced-context reachability** — marks *trace roots*
+(functions decorated with or passed to `jax.jit` / `shard_map` / `pmap`,
+bodies handed to `lax.scan` / `cond` / `while_loop` / `fori_loop` /
+`remat`, plus seams configured via ``[tool.distlint] trace_roots``) and
+propagates reachability down the existing call graph; a per-function
+**may-host-effect** summary (blocking store ops, `faults.fire`,
+`jax.device_get`, `.item()`, `block_until_ready`, rendezvous) propagates
+up it. Five rule families ride on top; their runtime complement is the
+``TDX_TRACE_GUARD=1`` guard in `traceguard.py` (the R011 analog of
+`schedule.py` for R001):
+
+R011  host-side effect reachable from a trace root: the function is (or
+      is transitively called from) a traced body, and it performs — or
+      calls a helper that may perform — a blocking store op,
+      `faults.fire`, `device_get`, `.item()` or another host effect.
+      The PR 10 planner-probe bug class: under tracing this blocks on a
+      tracer, runs once at trace time instead of per step, or raises
+      `TracerArrayConversionError`. Findings carry the root→site chain
+      and (for helper calls) the R001-style caller→callee effect trace.
+R012  use-after-donate: a value passed through a `donate_argnums` /
+      `donate_argnames`-marked call site (known from jit decorators,
+      `jit(fn, donate_argnums=...)` assignments, or interprocedural
+      escape summaries — a helper that forwards its parameter into a
+      donating slot donates its own parameter) and then *read* on any
+      following path. Flow-sensitive per scope; the rebind idiom
+      ``state = step(state)`` (and tuple-unpack rebinds) is clean.
+R013  paged-pool refcount pairing: a locally-acquired pool handle
+      (`allocate` / `ensure_blocks` / `attach_prefix` / `cow_block` on a
+      pool/cache-like receiver) that reaches a `return` — or falls off
+      the end of the function — without a `free()` / ownership hand-off
+      (stored into a structure, passed onward, or returned) on that
+      path. Raise paths are exempt; subjects that are function
+      parameters belong to the caller and are exempt.
+R014  unlocked shared-state mutation in a class declaring a `_lock`
+      discipline: a field assigned under ``with self._lock`` somewhere
+      in the class is also assigned outside it (``__init__`` exempt).
+R015  sharding-spec drift: a `PartitionSpec` literal (including
+      ``from jax.sharding import PartitionSpec as P`` aliases) naming an
+      axis that no mesh constructed project-wide declares (axis-name
+      literals are harvested from every `*Mesh*`/`make_mesh` call;
+      ``[tool.distlint] known_mesh_axes`` extends the registry).
+
 Suppressions
 ------------
 
@@ -128,6 +172,8 @@ Configuration
     exclude = ["csrc/"]
     dispatch_path_modules = ["store.py", "p2p.py", "..."]
     fault_registry = "pytorch_distributed_example_tpu/faults.py"
+    trace_roots = ["plan/driver.py::body_for.<locals>.*"]  # R011 seams
+    known_mesh_axes = []                                   # R015 registry extras
 
     [tool.distlint.severity]   # per-rule overrides: error | warning | off
     R010 = "warning"
@@ -189,6 +235,11 @@ RULES = {
     "R008": "fault-point name not present in the faults registry",
     "R009": "stale suppression matches no finding",
     "R010": "collective inside a loop whose trip count depends on rank-local data",
+    "R011": "host-side effect reachable from a jit/shard_map trace root",
+    "R012": "value read after being donated to a jitted call (use-after-donate)",
+    "R013": "pool acquisition leaks on a non-raising path (no free()/hand-off)",
+    "R014": "guarded field written outside the class's `_lock` discipline",
+    "R015": "PartitionSpec axis name not declared by any mesh project-wide",
 }
 
 SEVERITIES = ("error", "warning", "off")
@@ -268,6 +319,41 @@ _SCOPE_FIELD_RE = re.compile(
 # Blocking store ops for R003 (`check` is a non-blocking probe; `set`
 # and `add` complete locally against a live daemon).
 _STORE_BLOCKING_ATTRS = {"get", "wait", "barrier"}
+
+# -- trace-context model (R011) ---------------------------------------------
+# Wrappers whose function argument becomes a TRACED body. `shard_map` is
+# matched by substring so the repo's `_compat.shard_map_fn` wrapper (and
+# any future rename keeping the phrase) marks its argument too.
+_TRACE_WRAP_SIMPLE = {"jit", "pmap"}
+# lax control-flow combinators: positional indexes of their traced bodies.
+_LAX_BODY_POSITIONS = {
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "fori_loop": (2,),
+    "checkpoint": (0,),
+    "remat": (0,),
+}
+# Direct host-side primitives for the may-host-effect summary (blocking
+# store ops and rendezvous are classified separately, same as R003).
+_HOST_PRIM_NAMES = {"device_get", "block_until_ready"}
+
+# -- paged-pool lifecycle (R013) --------------------------------------------
+_POOL_ACQUIRE_ATTRS = {"allocate", "ensure_blocks", "attach_prefix", "cow_block"}
+# A class matching this implements the pool itself: its methods own the
+# refcount plumbing and are out of scope for the consumer-pairing rule.
+_POOL_IMPL_CLASS_RE = re.compile(r"pool|cache|block", re.IGNORECASE)
+
+# -- lock discipline (R014): `self._lock` plus the condition-variable
+# wrappers that hold it ------------------------------------------------------
+_LOCK_ATTRS = {"_lock", "_cv", "_cond", "_condition"}
+
+# Functions whose nested defs are traced bodies even though the analyzer
+# cannot see the hand-off (closures returned and shard_map-ed elsewhere).
+# `path-glob::name-glob` matched against (module path, qualified name).
+DEFAULT_TRACE_ROOTS = [
+    "pytorch_distributed_example_tpu/plan/driver.py::body_for.<locals>.*",
+]
 
 # Modules whose broad-except hygiene R005 polices. Matched as path
 # suffixes against the posix-style relative path.
@@ -350,6 +436,10 @@ class LintConfig:
     store_lifecycle_paths: List[str] = field(
         default_factory=lambda: list(DEFAULT_STORE_LIFECYCLE_PATHS)
     )
+    trace_roots: List[str] = field(
+        default_factory=lambda: list(DEFAULT_TRACE_ROOTS)
+    )
+    known_mesh_axes: List[str] = field(default_factory=list)
 
     def rule_severity(self, rule: str) -> str:
         return self.severity.get(rule, "error")
@@ -382,6 +472,10 @@ def load_config(root: str) -> LintConfig:
         cfg.fault_registry = str(section["fault_registry"])
     if "store_lifecycle_paths" in section:
         cfg.store_lifecycle_paths = [str(p) for p in section["store_lifecycle_paths"]]
+    if "trace_roots" in section:
+        cfg.trace_roots = [str(p) for p in section["trace_roots"]]
+    if "known_mesh_axes" in section:
+        cfg.known_mesh_axes = [str(p) for p in section["known_mesh_axes"]]
     for rule, sev in dict(section.get("severity", {})).items():
         sev = str(sev).lower()
         if sev not in SEVERITIES:
@@ -599,6 +693,103 @@ def _render_callee(call: ast.Call) -> str:
     return ".".join(reversed(parts))
 
 
+def _host_prim_label(call: ast.Call) -> Optional[str]:
+    """Display label when ``call`` is a DIRECT host-side primitive (the
+    R011 surface), else None. Blocking store ops reuse the R003
+    receiver heuristic; `.item()` only in its zero-arg reading form."""
+    name = _call_name(call)
+    if name is None:
+        return None
+    if name == "fire":
+        if isinstance(call.func, ast.Name):
+            return "faults.fire"
+        if isinstance(call.func, ast.Attribute) and any(
+            "fault" in n
+            for n in map(str.lower, _expr_all_idents(call.func.value))
+        ):
+            return "faults.fire"
+        return None
+    if name in _HOST_PRIM_NAMES:
+        return name
+    if (
+        name == "item"
+        and isinstance(call.func, ast.Attribute)
+        and not call.args
+        and not call.keywords
+    ):
+        return ".item()"
+    if name in ("rendezvous", "monitored_barrier"):
+        return name
+    if (
+        name in _STORE_BLOCKING_ATTRS
+        and isinstance(call.func, ast.Attribute)
+        and _receiver_mentions_store(call.func.value)
+    ):
+        return f"store.{name}"
+    return None
+
+
+def _int_constants(expr: ast.expr) -> Set[int]:
+    """Integer constants of a literal int / tuple / list / set."""
+    out: Set[int] = set()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        out.add(expr.value)
+    elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _donate_set_of_call(call: ast.Call, argnames: Sequence[str]) -> Set[int]:
+    """Donated positional indexes declared by a jit-like call's
+    ``donate_argnums`` / ``donate_argnames`` keywords (works for both
+    ``jax.jit(fn, ...)`` and ``functools.partial(jax.jit, ...)``)."""
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out |= _int_constants(kw.value)
+        elif kw.arg == "donate_argnames":
+            names: Set[str] = set()
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                names |= {
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+            out |= {argnames.index(n) for n in names if n in argnames}
+    return out
+
+
+def _bound_donates(t: "FunctionInfo") -> Set[int]:
+    """``t``'s effective donation set as seen at a BOUND call site:
+    methods drop the implicit receiver, so `donate_argnums=(1,)` on
+    `def step(self, state)` lands on the caller's arg 0."""
+    eff = t.donates | t.donates_params
+    if not eff or t.cls is None:
+        return eff
+    args = getattr(t.node, "args", None)
+    if args is None:
+        return eff
+    pos = [a.arg for a in (args.posonlyargs + args.args)]
+    if pos and pos[0] in ("self", "cls"):
+        return {i - 1 for i in eff if i >= 1}
+    return eff
+
+
+def _bare_names(expr: ast.expr) -> List[str]:
+    """Bare Name (or tuple/list-of-Name elements) of an argument — the
+    values whose buffers a donating call consumes."""
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [e.id for e in expr.elts if isinstance(e, ast.Name)]
+    return []
+
+
 # ---------------------------------------------------------------------------
 # project model: modules, functions, imports, call graph, effect inference
 # ---------------------------------------------------------------------------
@@ -621,15 +812,40 @@ class Effect:
 
 
 @dataclass
+class TraceCtx:
+    """How a function becomes reachable from a traced program body."""
+
+    reason: str  # why the ROOT is a trace root
+    root_display: str
+    root_path: str
+    root_line: int
+    chain: Tuple[str, ...]  # display names from the root down to this fn
+
+    def describe(self) -> str:
+        if len(self.chain) <= 1:
+            return f"a trace root ({self.reason})"
+        return (
+            f"reachable from trace root `{self.root_display}` "
+            f"({self.reason}, {self.root_path}:{self.root_line}; "
+            f"chain {' -> '.join(self.chain)})"
+        )
+
+
+@dataclass
 class FunctionInfo:
     module: str
-    name: str  # "func" or "Class.meth"
+    name: str  # "func", "Class.meth", or "outer.<locals>.inner"
     path: str
     node: ast.AST
     cls: Optional[str] = None
     group_param: Optional[str] = None
     coll_effect: Optional[Effect] = None
     store_effect: Optional[Effect] = None
+    host_effect: Optional[Effect] = None
+    trace_root: Optional[str] = None  # reason string when a trace root
+    trace_ctx: Optional[TraceCtx] = None
+    donates: Set[int] = field(default_factory=set)
+    donates_params: Set[int] = field(default_factory=set)
     edges: List[Tuple[int, "FunctionInfo"]] = field(default_factory=list)
 
     @property
@@ -711,13 +927,20 @@ class Project:
         self.by_path: Dict[str, ModuleInfo] = {}
         self.delete_key_prefixes: Set[str] = set()
         self.fault_points: Optional[Set[str]] = None
+        self.mesh_axes: Set[str] = set()
 
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def build(cls, sources: Dict[str, str]) -> "Project":
+    def build(
+        cls,
+        sources: Dict[str, str],
+        trace_roots: Sequence[str] = (),
+    ) -> "Project":
         """``sources``: relative posix path -> source text. Files that do
-        not parse are skipped here (lint_source reports E000 for them)."""
+        not parse are skipped here (lint_source reports E000 for them).
+        ``trace_roots``: configured `path-glob::name-glob` seam patterns
+        marked as traced bodies on top of the automatic detection."""
         proj = cls()
         for rel, src in sources.items():
             try:
@@ -732,8 +955,12 @@ class Project:
             proj._collect_module(minfo)
             proj.modules[name] = minfo
             proj.by_path[minfo.path] = minfo
+        proj._mark_trace_roots_and_donations(trace_roots)
         proj._compute_effects()
+        proj._compute_trace_reach()
+        proj._compute_donation_escapes()
         proj._collect_store_deletes()
+        proj._collect_mesh_axes()
         proj._extract_fault_registry()
         return proj
 
@@ -776,7 +1003,9 @@ class Project:
                         continue
                     m.from_imports[alias.asname or alias.name] = (target, alias.name)
 
-        def collect_defs(body, cls_name: Optional[str], prefix: str) -> None:
+        def collect_defs(
+            body, cls_name: Optional[str], prefix: str, nested: bool = False
+        ) -> None:
             for stmt in body:
                 if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     fq = f"{prefix}{stmt.name}"
@@ -787,6 +1016,22 @@ class Project:
                     m.functions[fq] = fi
                     if cls_name is not None:
                         m.classes[cls_name].methods[stmt.name] = fi
+                    # nested defs are registered too (trace roots live
+                    # there: jitted program factories define their traced
+                    # bodies inline) but never as re-resolvable symbols —
+                    # their dotted names miss resolve_symbol's bare-name
+                    # check by construction
+                    collect_defs(
+                        stmt.body, None, f"{fq}.<locals>.", nested=True
+                    )
+                elif isinstance(stmt, ast.ClassDef) and nested:
+                    # a function-local class: methods may still hold trace
+                    # roots, but registering the CLASS would shadow any
+                    # module-level one of the same name — recurse defs only
+                    collect_defs(
+                        stmt.body, None, f"{prefix}{stmt.name}.<locals>.",
+                        nested=True,
+                    )
                 elif isinstance(stmt, ast.ClassDef):
                     ci = ClassInfo(name=stmt.name, module=m.name)
                     for b in stmt.bases:
@@ -798,9 +1043,12 @@ class Project:
                 elif isinstance(stmt, (ast.If, ast.Try)):
                     # defs guarded by TYPE_CHECKING / version checks
                     for attr in ("body", "orelse", "finalbody"):
-                        collect_defs(getattr(stmt, attr, []) or [], cls_name, prefix)
+                        collect_defs(
+                            getattr(stmt, attr, []) or [], cls_name, prefix,
+                            nested,
+                        )
                     for h in getattr(stmt, "handlers", []) or []:
-                        collect_defs(h.body, cls_name, prefix)
+                        collect_defs(h.body, cls_name, prefix, nested)
 
         collect_defs(m.tree.body, None, "")
 
@@ -914,11 +1162,13 @@ class Project:
 
     # -- effect inference --------------------------------------------------
 
-    def _direct_effects(self, fi: FunctionInfo) -> Tuple[Optional[Effect], Optional[Effect]]:
+    def _direct_effects(
+        self, fi: FunctionInfo
+    ) -> Tuple[Optional[Effect], Optional[Effect], Optional[Effect]]:
         """Seed effects from the function's own body. The scan includes
         nested defs/lambdas on purpose (may analysis: a function that
         *builds* a collective-issuing closure is summarized as may-issue)."""
-        coll = store = None
+        coll = store = host = None
         body = getattr(fi.node, "body", [])
         for stmt in body:
             for node in ast.walk(stmt):
@@ -942,6 +1192,10 @@ class Project:
                         store = Effect(
                             "store", f"store.{name}", fi.path, line, (fi.display,)
                         )
+                if host is None:
+                    label = _host_prim_label(node)
+                    if label is not None:
+                        host = Effect("host", label, fi.path, line, (fi.display,))
         # Store subclasses' own get/wait/barrier are the primitives
         if (
             store is None
@@ -956,14 +1210,23 @@ class Project:
                 getattr(fi.node, "lineno", 0),
                 (fi.display,),
             )
-        return coll, store
+        # a blocking store op is a host effect too (the R011 surface is a
+        # superset of the R003 one)
+        if host is None and store is not None:
+            host = Effect(
+                "host", store.prim_name, store.prim_path, store.prim_line,
+                store.chain,
+            )
+        return coll, store, host
 
     def _compute_effects(self) -> None:
         funcs: List[FunctionInfo] = [
             fi for m in self.modules.values() for fi in m.functions.values()
         ]
         for fi in funcs:
-            fi.coll_effect, fi.store_effect = self._direct_effects(fi)
+            fi.coll_effect, fi.store_effect, fi.host_effect = (
+                self._direct_effects(fi)
+            )
         # call edges (resolved once; includes calls inside nested defs)
         for m in self.modules.values():
             for fi in m.functions.values():
@@ -993,6 +1256,178 @@ class Project:
                             ((fi.display,) + e.chain)[: self._MAX_CHAIN],
                         )
                         changed = True
+                    if fi.host_effect is None and t.host_effect is not None:
+                        e = t.host_effect
+                        fi.host_effect = Effect(
+                            "host", e.prim_name, e.prim_path, e.prim_line,
+                            ((fi.display,) + e.chain)[: self._MAX_CHAIN],
+                        )
+                        changed = True
+
+    # -- trace-context + donation model (R011/R012) ------------------------
+
+    def _mark_trace_roots_and_donations(self, patterns: Sequence[str]) -> None:
+        """Mark traced bodies and harvest donation declarations.
+
+        A function is a trace root when (a) a decorator mentions
+        jit/pmap/shard_map (covers `@jax.jit` and
+        `@functools.partial(jax.jit, ...)` alike), (b) it is passed by
+        name to a jit/pmap/*shard_map* wrapper or as a lax
+        scan/cond/while_loop/fori_loop/remat body, or (c) it matches a
+        configured `path-glob::name-glob` seam. Donation declarations
+        (`donate_argnums`/`donate_argnames`) are read off the same
+        decorators and wrap-call sites."""
+        for m in self.modules.values():
+            by_leaf: Dict[str, List[FunctionInfo]] = {}
+            for fi in m.functions.values():
+                by_leaf.setdefault(fi.name.rsplit(".", 1)[-1], []).append(fi)
+
+            def fn_argnames(fi: FunctionInfo) -> List[str]:
+                a = fi.node.args
+                return [x.arg for x in (a.posonlyargs + a.args)]
+
+            # (a) decorators
+            for fi in m.functions.values():
+                for dec in getattr(fi.node, "decorator_list", []):
+                    idents = _expr_all_idents(dec)
+                    hits = sorted(idents & _TRACE_WRAP_SIMPLE) + sorted(
+                        n for n in idents if "shard_map" in n
+                    )
+                    if not hits:
+                        continue
+                    if fi.trace_root is None:
+                        fi.trace_root = f"decorated with `{hits[0]}`"
+                    if isinstance(dec, ast.Call):
+                        fi.donates |= _donate_set_of_call(dec, fn_argnames(fi))
+
+            # (b) wrap-call sites + lax bodies
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name is None:
+                    continue
+                if name in _TRACE_WRAP_SIMPLE or "shard_map" in name:
+                    positions: Tuple[int, ...] = (0,)
+                    how = f"passed to `{name}`"
+                    donating = name in _TRACE_WRAP_SIMPLE
+                elif name in _LAX_BODY_POSITIONS:
+                    positions = _LAX_BODY_POSITIONS[name]
+                    how = f"body of `{name}`"
+                    donating = False
+                else:
+                    continue
+                for i in positions:
+                    if i >= len(node.args) or not isinstance(
+                        node.args[i], ast.Name
+                    ):
+                        continue
+                    for fi in by_leaf.get(node.args[i].id, []):
+                        if fi.trace_root is None:
+                            fi.trace_root = how
+                        if donating:
+                            fi.donates |= _donate_set_of_call(
+                                node, fn_argnames(fi)
+                            )
+
+            # (c) configured seams
+            for pat in patterns:
+                if "::" not in pat:
+                    continue
+                pglob, nglob = pat.split("::", 1)
+                if not fnmatch.fnmatch(m.path, pglob):
+                    continue
+                for fi in m.functions.values():
+                    if fi.trace_root is None and fnmatch.fnmatchcase(
+                        fi.name, nglob
+                    ):
+                        fi.trace_root = f"configured trace root `{pat}`"
+
+    def _compute_trace_reach(self) -> None:
+        """Traced-context reachability: flows DOWN the call graph (root →
+        callees), the opposite direction of the effect summaries."""
+        work: List[FunctionInfo] = []
+        for m in self.modules.values():
+            for fi in m.functions.values():
+                if fi.trace_root is not None:
+                    fi.trace_ctx = TraceCtx(
+                        reason=fi.trace_root,
+                        root_display=fi.display,
+                        root_path=fi.path,
+                        root_line=getattr(fi.node, "lineno", 0),
+                        chain=(fi.display,),
+                    )
+                    work.append(fi)
+        while work:
+            fi = work.pop()
+            ctx = fi.trace_ctx
+            if ctx is None or len(ctx.chain) >= self._MAX_CHAIN:
+                continue
+            for _line, t in fi.edges:
+                if t.trace_ctx is None:
+                    t.trace_ctx = TraceCtx(
+                        ctx.reason, ctx.root_display, ctx.root_path,
+                        ctx.root_line, ctx.chain + (t.display,),
+                    )
+                    work.append(t)
+
+    def _compute_donation_escapes(self) -> None:
+        """Interprocedural donation escape summaries: a function that
+        forwards its own parameter into a donated slot of a donating
+        callee donates that parameter from its caller's point of view."""
+        changed = True
+        while changed:
+            changed = False
+            for m in self.modules.values():
+                for fi in m.functions.values():
+                    args = getattr(fi.node, "args", None)
+                    if args is None:
+                        continue
+                    params = [a.arg for a in (args.posonlyargs + args.args)]
+                    if not params:
+                        continue
+                    for stmt in getattr(fi.node, "body", []):
+                        for node in _walk_skip_nested_funcs(stmt):
+                            if not isinstance(node, ast.Call):
+                                continue
+                            for t in self.resolve_call(m, fi.cls, node):
+                                for i in _bound_donates(t):
+                                    if i >= len(node.args):
+                                        continue
+                                    for nm in _bare_names(node.args[i]):
+                                        if nm not in params:
+                                            continue
+                                        pi = params.index(nm)
+                                        if pi not in fi.donates_params:
+                                            fi.donates_params.add(pi)
+                                            changed = True
+
+    def _collect_mesh_axes(self) -> None:
+        """Harvest axis-name string literals from every mesh-constructing
+        call project-wide (the R015 registry). Over-inclusive on purpose:
+        an extra registry entry only mutes the rule, never misfires it."""
+        for m in self.modules.values():
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name is None or "mesh" not in name.lower():
+                    continue
+                pools: List[ast.expr] = [
+                    a for a in node.args if isinstance(a, (ast.Tuple, ast.List))
+                ]
+                pools += [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg in ("axis_names", "axis_name", "axes")
+                    and kw.value is not None
+                ]
+                for expr in pools:
+                    for sub in ast.walk(expr):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            self.mesh_axes.add(sub.value)
 
     # -- project-wide store-key + fault-registry facts ---------------------
 
@@ -1944,6 +2379,748 @@ def _scan_fault_points(
                     emit(node, lit, "embedded JSON plan string")
 
 
+# -- R011: host effects reachable from trace roots --------------------------
+
+
+class _TraceHostEffectAnalyzer:
+    """For every function the project marked trace-reachable, flag direct
+    host-side primitives and calls to may-host-effect helpers inside its
+    body (nested defs included: a closure built in traced code runs under
+    the same trace when called). Dedupes by call node so a primitive
+    inside a registered nested trace root is reported once."""
+
+    def __init__(self, path: str, findings: List[Finding], project: Project,
+                 minfo: ModuleInfo):
+        self.path = path
+        self.findings = findings
+        self.project = project
+        self.minfo = minfo
+
+    def run(self) -> None:
+        seen: Set[int] = set()
+        for fi in self.minfo.functions.values():
+            ctx = fi.trace_ctx
+            if ctx is None:
+                continue
+            for stmt in getattr(fi.node, "body", []):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    label = _host_prim_label(node)
+                    if label is not None:
+                        seen.add(id(node))
+                        self._emit(
+                            fi, ctx, node,
+                            f"host-side op `{label}` can execute under jax "
+                            f"tracing: `{fi.display}` is {ctx.describe()}. A "
+                            "traced body must stay device-pure — this either "
+                            "raises TracerArrayConversionError or runs ONCE "
+                            "at trace time instead of every step",
+                            extra_trace=(),
+                        )
+                        continue
+                    name = _call_name(node)
+                    if name in COLLECTIVES or name == _DISPATCH_ATTR:
+                        continue
+                    targets = [
+                        t
+                        for t in self.project.resolve_call(
+                            self.minfo, fi.cls, node
+                        )
+                        if t.host_effect is not None
+                    ]
+                    if targets:
+                        t = targets[0]
+                        e = t.host_effect
+                        seen.add(id(node))
+                        self._emit(
+                            fi, ctx, node,
+                            f"call to `{t.display}` inside trace context "
+                            f"(`{fi.display}` is {ctx.describe()}); it may "
+                            f"perform host-side {e.describe()} — a traced "
+                            "body must stay device-pure",
+                            extra_trace=e.chain,
+                        )
+
+    def _emit(self, fi: FunctionInfo, ctx: TraceCtx, node: ast.AST,
+              message: str, extra_trace: Tuple[str, ...]) -> None:
+        f = Finding(
+            path=self.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule="R011",
+            message=message,
+            trace=tuple(ctx.chain) + tuple(extra_trace),
+        )
+        anchors: Tuple[int, ...] = (getattr(fi.node, "lineno", 0),)
+        if ctx.root_path == self.path:
+            anchors += (ctx.root_line,)
+        f._anchors = anchors  # type: ignore[attr-defined]
+        self.findings.append(f)
+
+
+# -- R012: flow-sensitive use-after-donate ----------------------------------
+
+
+class _DonationAnalyzer:
+    """Per-scope donated-name tracking. A donating call invalidates the
+    bare names it consumes UNLESS the same statement rebinds them
+    (``state = step(state)``); any later read of an invalidated name on
+    any path is use-after-donate. Loop bodies are walked twice so a
+    donation in iteration N is seen by the read at the top of N+1
+    (emissions dedupe, and the rebind idiom stays clean because the
+    rebind re-validates the name before the donating call re-reads it)."""
+
+    def __init__(self, path: str, findings: List[Finding], project: Project,
+                 minfo: Optional[ModuleInfo]):
+        self.path = path
+        self.findings = findings
+        self.project = project
+        self.minfo = minfo
+        self._cls: Optional[str] = None
+        self._emitted: Set[Tuple[int, str]] = set()
+
+    def run_module(self, tree: ast.Module) -> None:
+        self._scan_scope(tree.body, cls=None)
+        self._walk_defs(tree, None)
+
+    def _walk_defs(self, node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(child.body, cls)
+                self._walk_defs(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                self._walk_defs(child, child.name)
+            else:
+                self._walk_defs(child, cls)
+
+    def _scan_scope(self, body: List[ast.stmt], cls: Optional[str]) -> None:
+        self._cls = cls
+        self._local_donators: Dict[str, Set[int]] = {}
+        self._walk_block(body, {})
+
+    def _walk_block(
+        self, body: List[ast.stmt], donated: Dict[str, Tuple[int, str]]
+    ) -> None:
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # its own scope
+            if isinstance(stmt, ast.If):
+                self._check_reads(stmt.test, donated)
+                d1, d2 = dict(donated), dict(donated)
+                self._walk_block(stmt.body, d1)
+                self._walk_block(stmt.orelse, d2)
+                donated.clear()
+                donated.update(d2)
+                donated.update(d1)  # any-path union
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_reads(stmt.iter, donated)
+                self._walk_block(stmt.body, donated)
+                self._walk_block(stmt.body, donated)  # back-edge pass
+                self._walk_block(stmt.orelse, donated)
+                continue
+            if isinstance(stmt, ast.While):
+                self._check_reads(stmt.test, donated)
+                self._walk_block(stmt.body, donated)
+                self._walk_block(stmt.body, donated)  # back-edge pass
+                self._walk_block(stmt.orelse, donated)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_block(stmt.body, donated)
+                for h in stmt.handlers:
+                    self._walk_block(h.body, donated)
+                self._walk_block(stmt.orelse, donated)
+                self._walk_block(stmt.finalbody, donated)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check_reads(item.context_expr, donated)
+                self._walk_block(stmt.body, donated)
+                continue
+            self._process_stmt(stmt, donated)
+
+    def _process_stmt(
+        self, stmt: ast.stmt, donated: Dict[str, Tuple[int, str]]
+    ) -> None:
+        self._absorb_local_donator(stmt)
+        if isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            if stmt.target.id in donated:
+                self._emit(stmt.target, stmt.target.id, donated[stmt.target.id])
+        self._check_reads(stmt, donated)
+        new: Dict[str, Tuple[int, str]] = {}
+        for node in _walk_skip_nested_funcs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            dset, disp = self._donate_set(node)
+            for i in sorted(dset):
+                if i >= len(node.args):
+                    continue
+                for nm in _bare_names(node.args[i]):
+                    new.setdefault(nm, (getattr(node, "lineno", 0), disp))
+        targets = self._target_names(stmt)
+        for t in targets:
+            donated.pop(t, None)
+        for nm, info in new.items():
+            if nm not in targets:
+                donated[nm] = info
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    donated.pop(t.id, None)
+
+    def _check_reads(
+        self, node: ast.AST, donated: Dict[str, Tuple[int, str]]
+    ) -> None:
+        if not donated:
+            return
+        for sub in _walk_skip_nested_funcs(node):
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in donated
+            ):
+                self._emit(sub, sub.id, donated[sub.id])
+
+    def _emit(self, node: ast.AST, nm: str, info: Tuple[int, str]) -> None:
+        dl, disp = info
+        key = (getattr(node, "lineno", 0), nm)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        f = Finding(
+            path=self.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule="R012",
+            message=(
+                f"`{nm}` is read after being donated to `{disp}` (line {dl}): "
+                "a donated buffer aliases freed/overwritten device memory "
+                "once the call returns — rebind the result "
+                f"(`{nm} = {disp}(...)`) or drop it from donate_argnums"
+            ),
+        )
+        f._anchors = (dl,)  # type: ignore[attr-defined]
+        self.findings.append(f)
+
+    def _donate_set(self, call: ast.Call) -> Tuple[Set[int], str]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self._local_donators:
+            return self._local_donators[f.id], f.id
+        if self.minfo is not None:
+            for t in self.project.resolve_call(self.minfo, self._cls, call):
+                eff = _bound_donates(t)
+                if eff:
+                    return eff, t.display
+        return set(), ""
+
+    def _absorb_local_donator(self, stmt: ast.stmt) -> None:
+        """``step = jax.jit(fn, donate_argnums=(0,))``: calls through
+        ``step`` in this scope donate those positions."""
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        value = stmt.value
+        if not isinstance(value, ast.Call):
+            return
+        name = _call_name(value)
+        if name not in _TRACE_WRAP_SIMPLE and not (
+            name and "shard_map" in name
+        ):
+            return
+        d = _donate_set_of_call(value, ())
+        if not d:
+            return
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self._local_donators[t.id] = d
+
+    @staticmethod
+    def _target_names(stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        out.add(e.id)
+                    elif isinstance(e, ast.Starred) and isinstance(
+                        e.value, ast.Name
+                    ):
+                        out.add(e.value.id)
+        return out
+
+
+# -- R013: paged-pool acquisition/release pairing ---------------------------
+
+
+def _pool_like_receiver(expr: ast.expr) -> bool:
+    for n in map(str.lower, _expr_all_idents(expr)):
+        if "pool" in n or "cache" in n:
+            return True
+    return False
+
+
+class _PoolLifecycleAnalyzer:
+    """Per-function path walk: a locally-bound pool acquisition must be
+    released (free()/hand-off/returned) before every non-raising exit.
+    Subjects that are function parameters belong to the caller; methods
+    of pool/cache classes implement the refcounts and are exempt."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self._emitted: Set[Tuple[str, int]] = set()
+
+    def run_module(self, tree: ast.Module) -> None:
+        self._walk_defs(tree, None)
+
+    def _walk_defs(self, node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_func(child, cls)
+                self._walk_defs(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                self._walk_defs(child, child.name)
+            else:
+                self._walk_defs(child, cls)
+
+    def _scan_func(self, func, cls: Optional[str]) -> None:
+        if cls is not None and _POOL_IMPL_CLASS_RE.search(cls):
+            return
+        a = func.args
+        self._params = {
+            x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)
+        }
+        self._func = func
+        live: Dict[str, Tuple[int, str]] = {}
+        leftover = self._walk_block(func.body, live)
+        if leftover:
+            for nm, (aline, meth) in sorted(leftover.items()):
+                self._leak(
+                    func, nm, aline, meth,
+                    "before the function falls off its end",
+                )
+
+    def _walk_block(
+        self, body: List[ast.stmt], live: Dict[str, Tuple[int, str]]
+    ) -> Optional[Dict[str, Tuple[int, str]]]:
+        """Returns the live map at fall-through, or None when the block
+        diverts (return/raise — leaks flagged at the return)."""
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(stmt, ast.Raise):
+                return None  # raising paths are exempt
+            if isinstance(stmt, ast.Return):
+                self._apply_releases(stmt, live)
+                for nm, (aline, meth) in sorted(live.items()):
+                    self._leak(stmt, nm, aline, meth, "on this return path")
+                return None
+            if isinstance(stmt, ast.If):
+                l1 = self._branch_state(stmt.test, live, True)
+                l2 = self._branch_state(stmt.test, live, False)
+                r1 = self._walk_block(stmt.body, l1)
+                r2 = self._walk_block(stmt.orelse, l2)
+                live.clear()
+                if r1 is not None:
+                    live.update(r1)
+                if r2 is not None:
+                    live.update(r2)
+                if r1 is None and r2 is None:
+                    return None
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._walk_block(stmt.body, live)
+                self._walk_block(stmt.orelse, live)
+                continue
+            if isinstance(stmt, ast.While):
+                # `while slot is None:` — inside the body the handle holds
+                # nothing, so in-loop exits are not leaks; acquisitions
+                # made in the body surface to the fall-through state
+                body_live = self._branch_state(stmt.test, live, True)
+                self._walk_block(stmt.body, body_live)
+                self._walk_block(stmt.orelse, live)
+                for nm, info in body_live.items():
+                    live.setdefault(nm, info)
+                continue
+            if isinstance(stmt, ast.Try):
+                # `finally` runs on EVERY exit path, returns included:
+                # apply its releases up front so the canonical
+                # `try: return run(req)` / `finally: pool.free(b)` idiom
+                # is clean before the body's Return handler flags leaks
+                for fstmt in stmt.finalbody:
+                    self._apply_releases(fstmt, live)
+                self._walk_block(stmt.body, live)
+                for h in stmt.handlers:
+                    self._walk_block(h.body, dict(live))
+                self._walk_block(stmt.orelse, live)
+                self._walk_block(stmt.finalbody, live)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._apply_releases(item.context_expr, live)
+                self._walk_block(stmt.body, live)
+                continue
+            self._apply_releases(stmt, live)
+            self._apply_acquisitions(stmt, live)
+        return live
+
+    def _branch_state(
+        self, test: ast.expr, live: Dict[str, Tuple[int, str]], truthy: bool
+    ) -> Dict[str, Tuple[int, str]]:
+        """Copy of the live map entering one branch, condition-aware for
+        the allocate-failure idiom: on the `b is None` / `not b` branch
+        nothing was actually acquired."""
+        out = dict(live)
+        none_names: Set[str] = set()
+        t = test
+        if (
+            isinstance(t, ast.Compare)
+            and isinstance(t.left, ast.Name)
+            and len(t.ops) == 1
+            and len(t.comparators) == 1
+            and isinstance(t.comparators[0], ast.Constant)
+            and t.comparators[0].value is None
+        ):
+            if isinstance(t.ops[0], ast.Is) and truthy:
+                none_names.add(t.left.id)
+            if isinstance(t.ops[0], ast.IsNot) and not truthy:
+                none_names.add(t.left.id)
+        if (
+            isinstance(t, ast.UnaryOp)
+            and isinstance(t.op, ast.Not)
+            and isinstance(t.operand, ast.Name)
+            and truthy
+        ):
+            none_names.add(t.operand.id)
+        for nm in none_names:
+            out.pop(nm, None)
+        return out
+
+    def _apply_acquisitions(
+        self, stmt: ast.stmt, live: Dict[str, Tuple[int, str]]
+    ) -> None:
+        for node in _walk_skip_nested_funcs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in _POOL_ACQUIRE_ATTRS
+                and _pool_like_receiver(f.value)
+            ):
+                continue
+            subject: Optional[str] = None
+            if f.attr == "allocate":
+                # the handle is the RESULT: only a plain `b = pool.allocate()`
+                # binding is trackable
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and stmt.value is node
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    subject = stmt.targets[0].id
+            else:
+                # the handle is the SLOT (first argument)
+                if node.args and isinstance(node.args[0], ast.Name):
+                    subject = node.args[0].id
+            if subject is None or subject in self._params or subject == "self":
+                continue
+            live.setdefault(
+                subject,
+                (getattr(node, "lineno", 0), f"{_render_callee(node)}"),
+            )
+
+    def _apply_releases(
+        self, node: ast.AST, live: Dict[str, Tuple[int, str]]
+    ) -> None:
+        """Ownership leaves this path when the subject is passed to any
+        non-acquiring call (free(), append(), a helper), stored into a
+        structure (assign target is an attribute/subscript/other name),
+        or returned/yielded. Index-position reads (`kv[b] = x`) are not
+        hand-offs."""
+        if not live:
+            return
+        released: Set[str] = set()
+        for sub in _walk_skip_nested_funcs(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                acquiring = (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _POOL_ACQUIRE_ATTRS
+                )
+                if acquiring:
+                    continue
+                for arg in list(sub.args) + [
+                    kw.value for kw in sub.keywords if kw.value is not None
+                ]:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in live:
+                            released.add(n.id)
+            elif isinstance(sub, ast.Assign):
+                structured = any(
+                    isinstance(t, (ast.Attribute, ast.Subscript, ast.Name))
+                    for t in sub.targets
+                )
+                if structured and sub.value is not None:
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name) and n.id in live:
+                            released.add(n.id)
+                # `table[slot] = req` REGISTERS the handle under its own
+                # key — the ownership hand-off idiom of the slot tables
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript):
+                        for n in ast.walk(t.slice):
+                            if isinstance(n, ast.Name) and n.id in live:
+                                released.add(n.id)
+            elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                v = sub.value
+                if v is not None:
+                    for n in ast.walk(v):
+                        if isinstance(n, ast.Name) and n.id in live:
+                            released.add(n.id)
+        for nm in released:
+            live.pop(nm, None)
+
+    def _leak(
+        self, at: ast.AST, nm: str, aline: int, meth: str, where: str
+    ) -> None:
+        key = (nm, aline)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        f = Finding(
+            path=self.path,
+            line=getattr(at, "lineno", 0),
+            col=getattr(at, "col_offset", 0) + 1,
+            rule="R013",
+            message=(
+                f"`{nm}` acquired via `{meth}` (line {aline}) reaches no "
+                f"free() or ownership hand-off {where}: the paged pool "
+                "leaks a refcount on this path"
+            ),
+        )
+        f._anchors = (  # type: ignore[attr-defined]
+            aline,
+            getattr(self._func, "lineno", 0),
+        )
+        self.findings.append(f)
+
+
+# -- R014: `_lock` discipline -----------------------------------------------
+
+
+def _is_lock_with(stmt: ast.stmt) -> bool:
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return False
+    for item in stmt.items:
+        if _expr_all_idents(item.context_expr) & _LOCK_ATTRS:
+            return True
+    return False
+
+
+class _LockDisciplineAnalyzer:
+    """A class that takes `self._lock` (or its condition wrappers) around
+    SOME assignment of a field declares that field lock-guarded; any
+    other assignment of it outside the lock (``__init__`` excepted —
+    construction is single-threaded) is a race window."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def run_module(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        has_lock = any(
+            isinstance(t, ast.Attribute)
+            and t.attr in _LOCK_ATTRS
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            for meth in methods
+            for st in ast.walk(meth)
+            if isinstance(st, ast.Assign)
+            for t in st.targets
+        )
+        if not has_lock:
+            return
+        guarded: Dict[str, int] = {}  # field -> first guarded-write line
+        for meth in methods:
+            self._collect_guarded(meth.body, False, guarded)
+        for attr in _LOCK_ATTRS:
+            guarded.pop(attr, None)
+        if not guarded:
+            return
+        for meth in methods:
+            if meth.name == "__init__":
+                continue
+            self._flag_unlocked(meth, meth.body, False, guarded)
+
+    def _self_write_targets(self, stmt: ast.stmt) -> List[ast.Attribute]:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        out = []
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                tl = list(t.elts)
+            else:
+                tl = [t]
+            for x in tl:
+                if (
+                    isinstance(x, ast.Attribute)
+                    and isinstance(x.value, ast.Name)
+                    and x.value.id == "self"
+                ):
+                    out.append(x)
+        return out
+
+    def _collect_guarded(
+        self, body: List[ast.stmt], in_lock: bool, guarded: Dict[str, int]
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            inner = in_lock or _is_lock_with(stmt)
+            if inner:
+                for x in (
+                    n
+                    for n in ast.walk(stmt)
+                    if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                ):
+                    for t in self._self_write_targets(x):
+                        guarded.setdefault(t.attr, t.lineno)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                self._collect_guarded(
+                    getattr(stmt, attr, []) or [], in_lock, guarded
+                )
+            for h in getattr(stmt, "handlers", []) or []:
+                self._collect_guarded(h.body, in_lock, guarded)
+
+    def _flag_unlocked(
+        self, meth, body: List[ast.stmt], in_lock: bool,
+        guarded: Dict[str, int],
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _is_lock_with(stmt) or in_lock:
+                continue
+            for t in self._self_write_targets(stmt):
+                if t.attr in guarded:
+                    f = Finding(
+                        path=self.path,
+                        line=t.lineno,
+                        col=t.col_offset + 1,
+                        rule="R014",
+                        message=(
+                            f"`self.{t.attr}` is written without holding "
+                            f"`self._lock`, but the class guards this field "
+                            f"with the lock elsewhere (line "
+                            f"{guarded[t.attr]}): a concurrent reader sees "
+                            "a torn update"
+                        ),
+                    )
+                    f._anchors = (  # type: ignore[attr-defined]
+                        getattr(meth, "lineno", 0),
+                    )
+                    self.findings.append(f)
+            for attr in ("body", "orelse", "finalbody"):
+                self._flag_unlocked(
+                    meth, getattr(stmt, attr, []) or [], in_lock, guarded
+                )
+            for h in getattr(stmt, "handlers", []) or []:
+                self._flag_unlocked(meth, h.body, in_lock, guarded)
+
+
+# -- R015: sharding-spec axis drift -----------------------------------------
+
+
+class _ShardingSpecAnalyzer:
+    """PartitionSpec literals must name axes some mesh actually
+    constructs. Silent when no mesh is visible in the project scope (a
+    lone file with specs but no meshes proves nothing either way)."""
+
+    def __init__(self, path: str, findings: List[Finding], project: Project,
+                 minfo: Optional[ModuleInfo], config: "LintConfig"):
+        self.path = path
+        self.findings = findings
+        self.registry = set(project.mesh_axes) | set(config.known_mesh_axes)
+        self.aliases = {"PartitionSpec"}
+        if minfo is not None:
+            self.aliases |= {
+                local
+                for local, (_mod, orig) in minfo.from_imports.items()
+                if orig == "PartitionSpec"
+            }
+
+    def run_module(self, tree: ast.Module) -> None:
+        if not self.registry:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name not in self.aliases:
+                continue
+            # only bare-name / trailing-attr PartitionSpec constructors
+            for arg in node.args:
+                exprs = (
+                    list(arg.elts)
+                    if isinstance(arg, (ast.Tuple, ast.List))
+                    else [arg]
+                )
+                for e in exprs:
+                    if not (
+                        isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ):
+                        continue
+                    if e.value in self.registry:
+                        continue
+                    f = Finding(
+                        path=self.path,
+                        line=e.lineno,
+                        col=e.col_offset + 1,
+                        rule="R015",
+                        message=(
+                            f"PartitionSpec axis `{e.value}` is not an axis "
+                            "of any mesh constructed project-wide (known "
+                            f"axes: {sorted(self.registry)}): the spec can "
+                            "never be placed and fails at shard time"
+                        ),
+                    )
+                    f._anchors = (node.lineno,)  # type: ignore[attr-defined]
+                    self.findings.append(f)
+
+
 # ---------------------------------------------------------------------------
 # drivers
 # ---------------------------------------------------------------------------
@@ -2000,6 +3177,25 @@ def lint_source(
     _FunctionAnalyzer(path, findings, project, minfo).run_module(tree)
     _AsyncWindowAnalyzer(path, findings, project, minfo).run_module(tree)
     _WorkLifecycleAnalyzer(path, findings).run_module(tree)
+    # the trace/donation/spec rules need project facts (trace reach,
+    # donation summaries, the mesh-axis registry); a file linted without a
+    # project gets a throwaway single-module one so the module-local
+    # shapes of R011/R012/R015 still fire
+    tproject, tminfo = project, minfo
+    if tproject is None:
+        tproject = Project.build(
+            {path.replace(os.sep, "/"): src},
+            trace_roots=config.trace_roots,
+        )
+        tminfo = tproject.by_path.get(path.replace(os.sep, "/"))
+    if tminfo is not None:
+        _TraceHostEffectAnalyzer(path, findings, tproject, tminfo).run()
+        _DonationAnalyzer(path, findings, tproject, tminfo).run_module(tree)
+        _ShardingSpecAnalyzer(
+            path, findings, tproject, tminfo, config
+        ).run_module(tree)
+    _PoolLifecycleAnalyzer(path, findings).run_module(tree)
+    _LockDisciplineAnalyzer(path, findings).run_module(tree)
     if store_lifecycle is None:
         p = path.replace(os.sep, "/")
         store_lifecycle = any(
@@ -2175,7 +3371,7 @@ def build_project(
         rel = os.path.relpath(fp, root).replace(os.sep, "/")
         with open(fp, "r", encoding="utf-8") as fh:
             sources[rel] = fh.read()
-    proj = Project.build(sources)
+    proj = Project.build(sources, trace_roots=config.trace_roots)
     # the CONFIGURED registry module wins; Project.build's own scan (the
     # first */faults.py it happens to see) is only a fallback for callers
     # with no root/config to read from
@@ -2536,8 +3732,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="distlint",
         description=(
-            "interprocedural collective-divergence static analyzer "
-            "(rules R001-R010)"
+            "interprocedural collective-divergence + trace/donation "
+            "static analyzer (rules R001-R015)"
         ),
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: config paths)")

@@ -2,8 +2,11 @@
 
 Every file here exists to exercise one call-graph-builder edge (cycles,
 decorators, self-method resolution, re-exports, multi-hop effect
-propagation) and most carry INTENTIONAL findings — which is why
-pyproject's [tool.distlint] excludes this directory from the self-lint.
+propagation — plus, since v3, trace-root reachability in traced.py /
+hostops.py / planner_hook.py, donation flow in donate.py, pool pairing
+in pool.py, lock discipline in locks.py and spec drift in specs.py) and
+most carry INTENTIONAL findings — which is why pyproject's
+[tool.distlint] excludes this directory from the self-lint.
 """
 
 from .outer import entry  # re-export: resolving pkg.entry must chase this

@@ -1,0 +1,60 @@
+"""R012 corpus: use-after-donate vs the clean rebind idioms.
+
+Mirrors the PR 10 ZeRO shape: a jitted step donating its state buffers.
+The rebind idiom (`state = step(state)`, tuple-unpack rebinds) must stay
+clean; reading a donated name afterwards — directly, through a tuple
+argument, or through a helper whose parameter escapes into a donating
+slot — must flag."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def pair_step(a, b):
+    return a + 1.0, b + 1.0
+
+
+def good_rebind(state, batches):
+    for batch in batches:
+        state = step(state, batch)  # rebind: clean across iterations
+    return state
+
+
+def bad_use_after_donate(state, batch):
+    out = step(state, batch)
+    norm = state.sum()  # R012: `state` read after donation
+    return out, norm
+
+
+def good_tuple_unpack(a, b):
+    a, b = pair_step(a, b)  # both rebound: clean
+    return a, b
+
+
+def bad_tuple_unpack(a, b):
+    a2, b2 = pair_step(a, b)
+    return a2 + b2 + a  # R012: `a` read after its buffer was donated
+
+
+def wrapper(state, batch):
+    # escape summary: wrapper's `state` parameter flows into step's
+    # donated slot, so wrapper itself donates arg 0
+    return step(state, batch)
+
+
+def bad_through_wrapper(state, batch):
+    out = wrapper(state, batch)
+    return out, state.mean()  # R012: donation seen through the helper
+
+
+def local_jit_donator(fn, state, batch):
+    run = jax.jit(fn, donate_argnums=(0,))
+    out = run(state, batch)
+    return out, state  # R012: donated through the locally-built jit

@@ -1,0 +1,14 @@
+"""Host-side helpers for the R011 trace corpus: `probe_readback` is the
+may-host-effect helper a traced caller reaches through one hop."""
+
+import jax
+
+
+def probe_readback(x):
+    # the host primitive: materializes device data on the host
+    return jax.device_get(x)
+
+
+def measure_and_probe(x):
+    # second hop: a helper calling a helper (summary must propagate)
+    return probe_readback(x)

@@ -1,0 +1,36 @@
+"""R014 corpus: `_lock` discipline — a field guarded by the lock in one
+method must not be written lock-free in another."""
+
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # __init__ is exempt (single-threaded construction)
+        self.misses = 0
+
+    def record_hit(self):
+        with self._lock:
+            self.hits += 1  # declares `hits` lock-guarded
+
+    def record_miss(self):
+        with self._lock:
+            self.misses += 1
+
+    def reset(self):
+        self.hits = 0  # R014: guarded field written without the lock
+
+    def snapshot(self):
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses}  # reads: clean
+
+
+class Unlocked:
+    """No lock declared: free-threaded by contract, out of scope."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1  # clean: no `_lock` discipline declared

@@ -1,0 +1,30 @@
+"""R011 regression fixture for the PR 10 planner-hook bug: a comm hook
+applied inside the compiled train step whose per-leaf chooser PROBES at
+trace time — a device readback (`device_get` of what is a tracer under
+jit → `TracerArrayConversionError`) plus a blocking store agreement.
+The real `plan.ddp_comm_hook` declines in multiproc mode precisely to
+avoid this; if that decline ever regresses, this is the shape the lint
+must keep catching."""
+
+import jax
+
+
+def _measure(body, leaf):
+    t = body(leaf)
+    # the PR 10 crash site: device_get of a tracer inside the trace
+    return float(jax.device_get(t.ravel()[:1])[0])
+
+
+def choose_algorithm(store, body, leaf):
+    cached = store.get("plan/probe")  # blocking store agreement
+    if cached:
+        return cached
+    return _measure(body, leaf)
+
+
+@jax.jit
+def train_step_with_hook(grads, store, body):
+    # choosing (and probing) INSIDE the traced step: R011 through the
+    # chooser helper
+    alg = choose_algorithm(store, body, grads)
+    return grads, alg

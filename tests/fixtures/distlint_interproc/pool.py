@@ -1,0 +1,60 @@
+"""R013 corpus: paged-pool acquisition/release pairing.
+
+The acceptance shape is the early-return leak: a locally-allocated
+handle that a bail-out path abandons. Clean shapes: allocation-failure
+returns (`None` checks), free() on the abort path, slot-table
+registration (`table[slot] = req`), returning the handle, and
+caller-owned subjects (function parameters)."""
+
+
+def leak_on_early_return(pool, table, req, cap):
+    blocks = pool.allocate()
+    if cap < 1:
+        return None  # R013: `blocks` leaks on this path
+    table[req] = blocks
+    return req
+
+
+def clean_alloc_failure(pool):
+    blocks = pool.allocate()
+    if blocks is None:
+        return None  # clean: nothing was acquired
+    return blocks  # clean: ownership moves to the caller
+
+
+def clean_free_on_abort(pool, table, req, cap):
+    blocks = pool.allocate()
+    if cap < 1:
+        pool.free(blocks)  # released on the abort path
+        return None
+    table[req] = blocks
+    return req
+
+
+def clean_slot_table_registration(pool, slot_req, req):
+    slot = pool.allocate()
+    if slot is None:
+        return "blocked"
+    slot_req[slot] = req  # registered under its own key: handed off
+    return "admitted"
+
+
+def caller_owned_slot(pool, slot, budget):
+    # subject is a parameter: pairing is the CALLER's contract
+    pool.ensure_blocks(slot, budget)
+
+
+def leak_ensure_local(pool, order, budget, full):
+    slot = order.pop()
+    pool.ensure_blocks(slot, budget)
+    if full:
+        return False  # R013: locally-owned `slot` acquisition abandoned
+    pool.free(slot)
+    return True
+
+
+def clean_raise_path(pool, cap):
+    blocks = pool.allocate()
+    if cap < 1:
+        raise RuntimeError("over capacity")  # raising paths are exempt
+    return blocks

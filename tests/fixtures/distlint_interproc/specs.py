@@ -1,0 +1,22 @@
+"""R015 corpus: PartitionSpec axis names vs the project-wide mesh
+registry. `build_mesh` below declares (dp, tp); `bad_spec` names a
+`model` axis nothing constructs."""
+
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(2, 2), ("dp", "tp"))
+
+
+def good_spec():
+    return P("dp", None)
+
+
+def good_alias_spec():
+    return P(("dp", "tp"), None)
+
+
+def bad_spec():
+    return P("dp", "model")  # R015: no mesh constructs a `model` axis

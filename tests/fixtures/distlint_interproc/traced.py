@@ -1,0 +1,37 @@
+"""R011 corpus: host effects reachable from jit trace roots.
+
+`train_step` is the acceptance shape — a jit-decorated body reaching
+`jax.device_get` through TWO helper hops (`measure_and_probe` →
+`probe_readback`), so the finding must carry the caller→callee trace.
+`eager_probe` proves reachability is required: same helper call, no
+trace root, no finding."""
+
+import jax
+
+from . import hostops
+
+
+@jax.jit
+def train_step(state, batch):
+    state = state + batch
+    hostops.measure_and_probe(state)  # R011: host effect 2 hops down
+    return state
+
+
+@jax.jit
+def step_with_fire(x):
+    import pytorch_distributed_example_tpu.faults as faults
+
+    faults.fire("train.step")  # R011: direct host primitive under trace
+    return x * 2
+
+
+@jax.jit
+def step_with_store(x, store):
+    store.wait(["ready"])  # R011: blocking store op under trace
+    return x + 1
+
+
+def eager_probe(state):
+    # NOT trace-reachable: identical helper call, must stay clean
+    return hostops.probe_readback(state)

@@ -1,7 +1,8 @@
 """distlint unit fixtures: every rule R001-R010 has at least one positive
 (flagged) and one negative (clean) case, plus suppression, severity,
-baseline, SARIF and --fix coverage. Pure AST analysis — no jax, quick
-tier."""
+baseline, SARIF and --fix coverage (the v3 trace/donation rules
+R011-R015 live in tests/test_distlint_trace.py and the fixture corpus).
+Pure AST analysis — no jax, quick tier."""
 # distlint: disable-file=R008 -- the R008 POSITIVE fixtures embed deliberately-bogus point names inside fixture strings
 
 import json

@@ -103,7 +103,12 @@ def test_sarif_cli_gate():
     doc = json.loads(out.stdout)
     assert doc["version"] == "2.1.0"
     rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
-    assert {f"R{i:03d}" for i in range(1, 11)} <= rules
+    assert {f"R{i:03d}" for i in range(1, 16)} <= rules
+    # every emitted result (none expected at a clean ratchet, but any
+    # suppressed/baselined survivors too) must carry the fingerprint the
+    # ratchet keys on
+    for r in doc["runs"][0]["results"]:
+        assert r["partialFingerprints"]["distlint/v1"]
     # with the ratchet at zero stale entries, no result may be "new"
     assert not [
         r
