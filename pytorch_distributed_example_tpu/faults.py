@@ -62,6 +62,19 @@ Named injection points wired in this package:
                                                     the verifier names the
                                                     first divergent planner
                                                     step — plan/executor.py)
+    proglint.agree                                 (TDX_PROGLINT compiled-
+                                                    program agreement: before
+                                                    a rank publishes one
+                                                    program fingerprint
+                                                    through the group store;
+                                                    action "corrupt" perturbs
+                                                    the published digest so
+                                                    EVERY rank raises
+                                                    ProgramScheduleMismatch-
+                                                    Error at compile time
+                                                    instead of hanging in the
+                                                    first dispatch —
+                                                    schedule.agree_program)
     agent.heartbeat                                (node-elastic heartbeats)
     checkpoint.write / checkpoint.finalize         (integrity layer)
     serve.admit / serve.step                       (serve engine: before each
@@ -174,6 +187,7 @@ KNOWN_POINTS = frozenset({
     "schedule.mismatch",
     "plan.probe",
     "plan.step",
+    "proglint.agree",
     "agent.heartbeat",
     "checkpoint.write",
     "checkpoint.finalize",

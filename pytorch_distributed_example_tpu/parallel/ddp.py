@@ -34,6 +34,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import hashlib
+import os
 from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
@@ -540,7 +541,24 @@ def make_ddp_train_step(
         donate = zero.assert_donation_contract(
             donate, sharded_opt_state=zero_update
         )
-        return jax.jit(mapped, donate_argnums=donate)
+        jitted = jax.jit(mapped, donate_argnums=donate)
+        if os.environ.get("TDX_PROGLINT", "0") == "1":
+            # register-on-compile (tools/proglint.py): first call
+            # fingerprints the compiled collective sequence + donation
+            # set and agrees it across ranks before dispatch — the ZeRO
+            # psum_scatter/all_gather halves are exactly the programs
+            # the source-plane linter cannot see
+            from ..tools import proglint
+
+            jitted = proglint.instrument(
+                "ddp.train_step."
+                + ("zero" if zero_update else "replicated"),
+                jitted,
+                path="pytorch_distributed_example_tpu/parallel/ddp.py",
+                mesh_axes=tuple(mesh.axis_names),
+                world=W,
+            )
+        return jitted
 
     jitted = None if zero_update else _build_jitted(P())
 

@@ -121,10 +121,6 @@ def maybe_lower(group, op_name: str, array, plan_args: dict, fallback=None):
 
 
 def _lower_driver(group, op_name: str, array, reduce_kind: str):
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    from .._compat import shard_map_fn
     from ..backends.xla import AXIS
     from ..types import ArrayWork, OpType
 
@@ -147,10 +143,9 @@ def _lower_driver(group, op_name: str, array, reduce_kind: str):
     key = (op_name, alg, shape, str(array.dtype), reduce_kind)
     prog = cache.get(key)
     if prog is None:
-        body = driver.body_for(op_name, alg, W, AXIS, reduce_kind)
-        prog = jax.jit(shard_map_fn(
-            body, mesh=pl.mesh, in_specs=P(AXIS), out_specs=P(AXIS),
-        ))
+        prog = driver.compiled_body(
+            op_name, alg, W, AXIS, pl.mesh, reduce_kind
+        )
         cache[key] = prog
 
     optype = {

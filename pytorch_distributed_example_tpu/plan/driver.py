@@ -25,10 +25,12 @@ ppermutes (latency-optimal round count, power-of-two worlds).
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 __all__ = [
-    "body_for", "supports", "driver_candidates", "reduce_kind_of",
+    "body_for", "compiled_body", "supports", "driver_candidates",
+    "reduce_kind_of",
 ]
 
 _SUM_KINDS = ("sum", "avg")
@@ -75,6 +77,41 @@ def driver_candidates(op_name: str, world: int, reduce_kind: str = "sum"):
         a for a in ("onepass", "ring", "rhd")
         if supports(op_name, a, world, reduce_kind)
     )
+
+
+def compiled_body(op_name: str, algorithm: str, world: int, axis: str,
+                  mesh, reduce_kind: str = "sum"):
+    """jit-compiled shard_map realization of `body_for` over ``mesh`` —
+    THE driver-plane compile seam (`plan/__init__._lower_driver` and the
+    proglint program catalog both build through here, so there is one
+    place a schedule body becomes an executable).
+
+    Under ``TDX_PROGLINT=1`` the returned program is wrapped in
+    `tools/proglint.instrument`: its first call fingerprints the
+    lowered collective sequence (the ppermute rounds ARE the schedule)
+    and, in a multiproc gang, agrees it across ranks through the group
+    store before anything dispatches — the verification half ROADMAP
+    item 4's trace-time planner choices need."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map_fn
+
+    body = body_for(op_name, algorithm, world, axis, reduce_kind)
+    prog = jax.jit(shard_map_fn(
+        body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+    ))
+    if os.environ.get("TDX_PROGLINT", "0") == "1":
+        from ..tools import proglint
+
+        prog = proglint.instrument(
+            f"plan.{op_name}.{algorithm}",
+            prog,
+            path="pytorch_distributed_example_tpu/plan/driver.py",
+            mesh_axes=tuple(getattr(mesh, "axis_names", ())),
+            world=world,
+        )
+    return prog
 
 
 def _combine(reduce_kind: str):

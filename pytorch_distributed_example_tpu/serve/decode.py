@@ -33,12 +33,33 @@ cached per (model, sampling knobs) exactly like `generate._programs`
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 from ..models.generate import init_cache, sample_logits
 from .cache import land_slot
 
 __all__ = ["slot_programs", "paged_programs", "sync_slot_lanes"]
+
+_DECODE_PATH = "pytorch_distributed_example_tpu/serve/decode.py"
+
+
+def _register_programs(family: str, **programs):
+    """TDX_PROGLINT=1 register-on-compile seam: wrap each jitted serve
+    program so its first call fingerprints the compiled collective
+    sequence + donation set and (multiproc) agrees it across ranks
+    before dispatch (`tools/proglint.py`). Off by default — the seam
+    costs one env read per engine construction, nothing per step."""
+    if os.environ.get("TDX_PROGLINT", "0") != "1":
+        return tuple(programs.values())
+    from ..tools import proglint
+
+    return tuple(
+        proglint.instrument(
+            f"serve.{family}.{key}", fn, path=_DECODE_PATH
+        )
+        for key, fn in programs.items()
+    )
 
 
 def sync_slot_lanes(lengths, tokens, rngs):
@@ -127,7 +148,9 @@ def slot_programs(model, temperature: float, top_k: Optional[int]):
             new_rngs,
         )
 
-    return prefill, write_slot, step
+    return _register_programs(
+        "slot", prefill=prefill, write_slot=write_slot, step=step
+    )
 
 
 @functools.lru_cache(maxsize=32)
@@ -232,4 +255,10 @@ def paged_programs(model, temperature: float, top_k: Optional[int]):
             new_rngs,
         )
 
-    return prefill_chunk, first_token, attach, step
+    return _register_programs(
+        "paged",
+        prefill_chunk=prefill_chunk,
+        first_token=first_token,
+        attach=attach,
+        step=step,
+    )

@@ -3405,6 +3405,27 @@ def lint_paths(
     return findings
 
 
+def harvested_mesh_axes(
+    root: str = ".",
+    config: Optional[LintConfig] = None,
+    project: Optional[Project] = None,
+) -> frozenset:
+    """The R015 mesh-axis registry, exported for cross-tool consumers.
+
+    ONE source of truth for "which axis names exist in this project":
+    every axis-name literal harvested from the project's own
+    mesh-constructing calls (`Project._collect_mesh_axes`) plus the
+    ``[tool.distlint] known_mesh_axes`` extras. `tools/proglint.py`
+    rule J001 consumes this set instead of re-harvesting, so the
+    source-plane rule (R015) and the program-plane rule (J001) can
+    never drift onto two different registries — covered by the
+    cross-tool test in tests/test_proglint_self.py."""
+    config = config or load_config(root)
+    if project is None:
+        project = build_project(None, root, config)
+    return frozenset(project.mesh_axes) | frozenset(config.known_mesh_axes)
+
+
 # ---------------------------------------------------------------------------
 # baseline & ratchet
 # ---------------------------------------------------------------------------
@@ -3466,6 +3487,7 @@ def write_baseline(
     findings: List[Finding],
     naive_count: Optional[int] = None,
     allow_growth: bool = False,
+    tool: str = "distlint",
 ) -> int:
     """Write the ratchet file. Refuses to admit any entry that was not
     already grandfathered (identity by path+rule+fingerprint, NOT by
@@ -3498,7 +3520,7 @@ def write_baseline(
             )
     doc = {
         "version": 1,
-        "tool": "distlint",
+        "tool": tool,
         "naive_first_run_count": (
             naive_count if naive_count is not None
             else (prev_naive if prev_naive is not None else len(entries))
@@ -3520,6 +3542,7 @@ def render_report(
     findings: List[Finding],
     show_suppressed: bool = False,
     show_baselined: bool = False,
+    tool: str = "distlint",
 ) -> str:
     lines: List[str] = []
     active = [
@@ -3544,7 +3567,7 @@ def render_report(
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
     summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) or "none"
     lines.append(
-        f"distlint: {len(active)} finding(s) ({summary}); "
+        f"{tool}: {len(active)} finding(s) ({summary}); "
         f"{len(warnings)} warning(s); {n_base} baselined; {n_sup} suppressed"
     )
     return "\n".join(lines)
@@ -3557,13 +3580,21 @@ def render_sarif(
     findings: List[Finding],
     show_suppressed: bool = False,
     baseline_mode: Optional[bool] = None,
+    tool_name: str = "distlint",
+    rules: Optional[Dict[str, str]] = None,
+    information_uri: Optional[str] = None,
+    fingerprint_key: str = "distlint/v1",
 ) -> Dict:
     """SARIF 2.1.0 document. When a baseline was applied, baselined
     findings carry baselineState=unchanged and the rest baselineState=new.
     Pass ``baseline_mode`` explicitly when an EMPTY baseline was applied —
     auto-detection (any f.baselined) cannot see the difference between
     "no baseline" and "baseline that matched nothing", and a consumer
-    filtering on baselineState=='new' must not lose findings then."""
+    filtering on baselineState=='new' must not lose findings then.
+
+    ``tool_name``/``rules``/``information_uri``/``fingerprint_key`` let a
+    sibling analyzer (tools/proglint.py) emit its own driver block
+    through this one renderer instead of forking the SARIF layout."""
     if baseline_mode is None:
         baseline_mode = any(f.baselined for f in findings)
     results = []
@@ -3586,7 +3617,7 @@ def render_sarif(
                     }
                 }
             ],
-            "partialFingerprints": {"distlint/v1": f.fingerprint},
+            "partialFingerprints": {fingerprint_key: f.fingerprint},
         }
         if f.trace:
             res["message"]["text"] += "  [chain: " + " -> ".join(f.trace) + "]"
@@ -3606,16 +3637,19 @@ def render_sarif(
             {
                 "tool": {
                     "driver": {
-                        "name": "distlint",
+                        "name": tool_name,
                         "informationUri": (
-                            "pytorch_distributed_example_tpu/tools/distlint.py"
+                            information_uri
+                            or "pytorch_distributed_example_tpu/tools/distlint.py"
                         ),
                         "rules": [
                             {
                                 "id": rid,
                                 "shortDescription": {"text": desc},
                             }
-                            for rid, desc in sorted(RULES.items())
+                            for rid, desc in sorted(
+                                (rules if rules is not None else RULES).items()
+                            )
                         ],
                     }
                 },

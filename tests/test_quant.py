@@ -34,25 +34,21 @@ def no_fault_plan():
     faults.clear_plan()
 
 
-def _collectives(jaxpr, acc=None):
-    """Collect (primitive_name, [invar dtypes/shapes]) for every
-    collective in a jaxpr, recursing into sub-jaxprs."""
-    if acc is None:
-        acc = []
-    for eq in jaxpr.eqns:
-        if eq.primitive.name in ("all_to_all", "all_gather", "psum"):
-            acc.append(
-                (
-                    eq.primitive.name,
-                    [(str(v.aval.dtype), tuple(v.aval.shape)) for v in eq.invars],
-                )
-            )
-        for v in eq.params.values():
-            if hasattr(v, "jaxpr"):  # ClosedJaxpr
-                _collectives(v.jaxpr, acc)
-            elif hasattr(v, "eqns"):  # raw Jaxpr
-                _collectives(v, acc)
-    return acc
+def _collectives(jaxpr):
+    """(primitive_name, [invar dtypes/shapes]) per collective — now a
+    thin view over the SHARED recursive walker in `tools/proglint.py`
+    (promoted from this file in ISSUE 14), so this pin and proglint
+    rule J004 read the same eqns and can never drift apart."""
+    from pytorch_distributed_example_tpu.tools.proglint import (
+        collect_collectives,
+    )
+
+    return [
+        (eq.primitive, list(eq.operands))
+        for eq in collect_collectives(
+            jaxpr, prims=("all_to_all", "all_gather", "psum")
+        )
+    ]
 
 
 class TestBlockCodec:
@@ -262,6 +258,16 @@ class TestQuantizedAllReduce:
                 phase,
                 by_name,
             )
+        # and the SAME lowering is clean under proglint rule J004 (the
+        # generalized form of this pin) — one contract, two consumers
+        from pytorch_distributed_example_tpu.tools.proglint import (
+            collect_collectives,
+            quantized_wire_violations,
+        )
+
+        assert not quantized_wire_violations(
+            collect_collectives(jax.make_jaxpr(fn)(x).jaxpr)
+        )
 
     def test_tiny_buffer_falls_back_to_exact_psum(self, world):
         """Below ~world*block/4 elements the padded quantized layout
