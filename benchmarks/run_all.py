@@ -283,6 +283,22 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # closed-loop SLO autoscaling under the 10x diurnal
+            # open-loop load harness (ISSUE 15): gold attainment >=
+            # 0.99 across the swing, chip-seconds saved vs static peak
+            # provisioning, chaos-proven token-exact mid-swing resize —
+            # hermetic on the virtual clock in both modes
+            "serve_autoscale",
+            [sys.executable, "benchmarks/load_harness.py"]
+            + (
+                ["--preset", "tiny", "--duration", "30", "--tenants",
+                 "4", "--max-replicas", "4"]
+                if q
+                else ["--preset", "small"]
+            ),
+            {},
+        ),
+        (
             # tensor-parallel decode goodput scaling 1 -> 2 chips
             # (ISSUE 6, >= 1.7x target on TPU; CPU runs are a virtual-
             # device wiring smoke, not a measurement)

@@ -3,4 +3,5 @@ from .agent import (  # noqa: F401
     WorkerSpec,
     WorkerState,
     request_join,
+    request_resize,
 )

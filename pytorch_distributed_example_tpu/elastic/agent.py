@@ -38,6 +38,10 @@ class WorkerState(enum.Enum):
     # re-form at the next generation boundary (torchelastic's
     # num_nodes_waiting poll, elastic/agent/server/api.py:952-970)
     SCALE_UP = "SCALE_UP"
+    # agent-internal: a controller published an explicit local gang
+    # size (`request_resize` — the serve autoscaler's out-of-process
+    # path); re-form at that size at the next generation boundary
+    RESIZE = "RESIZE"
 
 
 @dataclass
@@ -175,6 +179,10 @@ class RunResult:
 
 
 _JOIN_KEY = "agent/join_waiting"  # NOT generation-namespaced: must survive re-forms
+# Controller-requested gang size (request_resize): a single overwritten
+# target the agent consumes (deletes) at the generation boundary that
+# satisfies it — latest write wins, stale targets cannot replay.
+_RESIZE_KEY = "agent/resize_target"
 _FATAL_KEY = "agent/fatal"
 
 # Agent -> serve-loop drain contract: the agent sets
@@ -218,6 +226,32 @@ def request_join(master_addr: str, master_port: int, timeout: float = 30.0) -> i
     s = TCPStore(master_addr, master_port, is_master=False, timeout=timeout)
     try:
         return _join_add(s, 1)
+    finally:
+        s.close()
+
+
+def request_resize(
+    master_addr: str, master_port: int, nproc: int, timeout: float = 30.0
+) -> None:
+    """Ask a running single-node ELASTIC agent (``min_nproc`` set) to
+    re-form its worker gang at exactly `nproc` workers at the next
+    generation boundary — the serve autoscaler's out-of-process scale
+    path (ISSUE 15). The agent clamps the target to its
+    ``[min_nproc, nproc_per_node]`` range, gives serve loops the
+    ``serve_drain_grace_s`` window to checkpoint (PR 8 seam), fires
+    the ``agent.resize`` fault point on the world change, and respawns.
+    Latest request wins — the key is a single overwritten target."""
+    if master_port <= 0:
+        raise ValueError(
+            "request_resize needs the agent's BOUND store port — read "
+            "agent.join_endpoint or the 'elastic join endpoint' stderr "
+            "line"
+        )
+    if nproc < 1:
+        raise ValueError(f"nproc must be >= 1, got {nproc}")
+    s = TCPStore(master_addr, master_port, is_master=False, timeout=timeout)
+    try:
+        s.set(_RESIZE_KEY, str(int(nproc)).encode())
     finally:
         s.close()
 
@@ -513,6 +547,8 @@ class LocalElasticAgent:
                 and self._join_waiting() > 0
             ):
                 return WorkerState.SCALE_UP
+            if self.spec.elastic and self._resize_target() is not None:
+                return WorkerState.RESIZE
             if ctrl is not None:
                 g = self._peek(ctrl, "agent/restart_gen")
                 if g is not None and int(g) > self.restart_count:
@@ -529,6 +565,45 @@ class LocalElasticAgent:
             return _join_add(store, 0)
         except Exception:
             return 0
+
+    def _resize_target(self) -> Optional[int]:
+        """The controller-requested LOCAL gang size, clamped to
+        [min_nproc, nproc_per_node]; None when absent or already
+        satisfied. A satisfied (or unparseable) target is consumed here
+        so the monitor cannot spin on a stale key."""
+        store = self._ensure_store()
+        if store is None:
+            return None
+        raw = self._peek(store, _RESIZE_KEY)
+        if raw is None:
+            return None
+        target = self._clamp_resize(raw)
+        if target == self.active_nproc:
+            self._consume_resize_key(store, raw)
+            return None
+        return target
+
+    def _clamp_resize(self, raw: bytes) -> int:
+        try:
+            target = int(raw)
+        except ValueError:
+            target = self.active_nproc  # garbage target: treat as met
+        return max(
+            self.spec.min_nproc or 1,
+            min(target, self.spec.nproc_per_node),
+        )
+
+    def _consume_resize_key(self, store, acted_on: bytes) -> None:
+        """Delete the resize target ONLY while it still holds the value
+        just acted on — latest-write-wins means a NEWER target published
+        meanwhile (the teardown window is seconds wide) must survive
+        for the next monitor tick, not be destroyed with the old one."""
+        try:
+            cur = self._peek(store, _RESIZE_KEY)
+            if cur is not None and cur == acted_on:
+                store.delete_key(_RESIZE_KEY)
+        except Exception:
+            pass  # best-effort GC; re-read next tick is harmless
 
     def _admit_joiners(self, survivors: int) -> int:
         """Consume queued join requests up to the spec max; returns the
@@ -1240,6 +1315,33 @@ class LocalElasticAgent:
                     self.active_nproc = self._admit_joiners(self.active_nproc)
                     self.restart_count += 1
                     self._start_workers()
+                    continue
+                if state is WorkerState.RESIZE:
+                    # controller-requested resize (request_resize — the
+                    # serve autoscaler's path): re-form the local gang
+                    # at the clamped target. Serve loops get the drain
+                    # grace to checkpoint; _start_workers fires
+                    # agent.resize on the world change. ONE raw read
+                    # drives both the act and the consume — a NEWER
+                    # target published during the seconds-wide teardown
+                    # must survive for the next monitor tick.
+                    store = self._ensure_store()
+                    raw = (
+                        self._peek(store, _RESIZE_KEY)
+                        if store is not None
+                        else None
+                    )
+                    if raw is not None:
+                        target = self._clamp_resize(raw)
+                        if target != self.active_nproc:
+                            self._signal_drain()
+                            self._stop_workers()
+                            self.active_nproc = target
+                            self._consume_resize_key(store, raw)
+                            self.restart_count += 1
+                            self._start_workers()
+                        else:
+                            self._consume_resize_key(store, raw)
                     continue
                 # failure: tear down the whole gang and re-rendezvous —
                 # surviving serve loops get the drain grace to checkpoint

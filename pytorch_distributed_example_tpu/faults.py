@@ -102,6 +102,20 @@ Named injection points wired in this package:
     serve.restore                                  (before a serve-state
                                                     checkpoint is read back on
                                                     the re-formed gang)
+    serve.scale_out / serve.scale_in               (DP serve router, before a
+                                                    replica is added / before a
+                                                    scale-in victim is drained
+                                                    — both fire with the gang
+                                                    untouched, so a transient
+                                                    fault aborts the resize at
+                                                    a consistent size and
+                                                    every in-flight request
+                                                    replays token-exact)
+    router.route                                   (before a request is routed
+                                                    to its affinity replica —
+                                                    fired with nothing routed,
+                                                    so a retried submit routes
+                                                    identically)
     agent.resize                                   (elastic agent, before
                                                     respawning a gang at a
                                                     CHANGED world size —
@@ -197,6 +211,9 @@ KNOWN_POINTS = frozenset({
     "serve.step",
     "serve.drain",
     "serve.restore",
+    "serve.scale_out",
+    "serve.scale_in",
+    "router.route",
     "agent.resize",
     "train.step",
 })

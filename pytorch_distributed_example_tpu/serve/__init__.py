@@ -29,6 +29,17 @@ checkpoint / restore of the serving plane through the incarnation-
 scoped store so an elastic-agent restart or resize replays interrupted
 requests token-identically (`elastic.py`), with per-class and
 recovery-time metrics on ``/serve``.
+
+Closed-loop autoscaling (ISSUE 15): a data-parallel router across
+engine replicas with session affinity on the radix prefix scopes
+(`router.py` — a tenant's shared blocks stay hot on one replica;
+replica loss re-routes and replays) and an SLO controller
+(`autoscale.py`) that polls ROLLING-WINDOW attainment / queue depth /
+pool pressure (`metrics.py::window_view`) and drives drain-backed
+scale-out/scale-in with hysteresis bands, breach streaks, cooldowns,
+and a max-step clamp — every decision logged with the metric view
+that justified it, `TDX_AUTOSCALE_FORCE` for operators.
+`benchmarks/load_harness.py` is the 10-100x open-loop proof.
 """
 
 from .bucketing import bucket_for, bucket_lengths  # noqa: F401
@@ -49,9 +60,15 @@ from .elastic import (  # noqa: F401
     save_serve_state,
     signal_drain,
 )
+from .autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    Autoscaler,
+    Decision,
+)
 from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
-from .prefix import PrefixIndex  # noqa: F401
+from .prefix import PrefixIndex, prefix_scope  # noqa: F401
+from .router import ScaleEvent, ServeRouter  # noqa: F401
 from .queue import (  # noqa: F401
     ClassSpec,
     Completion,

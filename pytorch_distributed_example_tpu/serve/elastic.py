@@ -63,8 +63,8 @@ __all__ = [
 SERVE_CKPT_PREFIX = "serve/ckpt"
 
 
-def _ckpt_key(gen: int) -> str:
-    return f"{SERVE_CKPT_PREFIX}/gen{gen}"
+def _ckpt_key(gen: int, key_prefix: str = SERVE_CKPT_PREFIX) -> str:
+    return f"{key_prefix}/gen{gen}"
 
 
 def _seal(state: Dict) -> bytes:
@@ -94,41 +94,50 @@ def _unseal(blob: bytes) -> Optional[Dict]:
         return None
 
 
-def save_serve_state(store, gen: int, state: Dict) -> str:
+def save_serve_state(
+    store, gen: int, state: Dict, key_prefix: str = SERVE_CKPT_PREFIX
+) -> str:
     """Persist a `ServeEngine.drain()` snapshot for generation `gen`.
 
     One atomic set per generation key + an overwritten latest pointer;
     earlier generations stay sealed in place as the fallback chain.
-    Returns the key written."""
-    key = _ckpt_key(gen)
+    `key_prefix` namespaces independent serve planes on one store —
+    the DP router (ISSUE 15) seals each drained REPLICA's snapshot
+    under its own prefix, so replica checkpoints can never clobber the
+    whole-plane chain (or each other). Returns the key written."""
+    key = _ckpt_key(gen, key_prefix)
     store.set(key, _seal(dict(state, generation=int(gen))))
     # the pointer is a single overwritten key (the incarnation scope
     # lives in the per-generation blobs it points AT)
-    store.set(f"{SERVE_CKPT_PREFIX}/latest", str(int(gen)).encode())
+    store.set(f"{key_prefix}/latest", str(int(gen)).encode())
     return key
 
 
 def load_serve_state(
-    store, upto_gen: Optional[int] = None, max_back: int = 8
+    store,
+    upto_gen: Optional[int] = None,
+    max_back: int = 8,
+    key_prefix: str = SERVE_CKPT_PREFIX,
 ) -> Tuple[Optional[Dict], int]:
     """Read back the newest VERIFIED serve checkpoint.
 
     Starts at the latest pointer (or `upto_gen`) and walks generations
     downward: a blob that fails its CRC manifest is warned about and
     skipped — the newest earlier generation that verifies wins (the
-    last-good fallback). Returns (state, generation) or (None, -1)
+    last-good fallback). `key_prefix` selects the plane (see
+    `save_serve_state`). Returns (state, generation) or (None, -1)
     when nothing restorable exists (a fresh gang starts empty)."""
     faults.fire("serve.restore", upto_gen=upto_gen)
     start = upto_gen
     if start is None:
         try:
-            if not store.check([f"{SERVE_CKPT_PREFIX}/latest"]):
+            if not store.check([f"{key_prefix}/latest"]):
                 return None, -1
-            start = int(store.get(f"{SERVE_CKPT_PREFIX}/latest").decode())
+            start = int(store.get(f"{key_prefix}/latest").decode())
         except Exception:
             return None, -1
     for gen in range(int(start), max(int(start) - max_back, -1), -1):
-        key = _ckpt_key(gen)
+        key = _ckpt_key(gen, key_prefix)
         try:
             if not store.check([key]):
                 continue

@@ -498,16 +498,12 @@ class ServeEngine:
         return "admitted"
 
     def _prefix_scope(self, req: Request):
-        """The sharing boundary for `req`'s prefix-cache entries: a
-        PRIVATE per-tenant scope unless the request's class opts into
-        cross-tenant sharing (`ClassSpec.share_prefix` — both sides of
-        any cross-tenant hit opted in by construction, since matching
-        only ever happens within one scope)."""
-        if self.classes is not None:
-            spec = self.classes.get(req.klass)
-            if spec is not None and spec.share_prefix:
-                return "*"
-        return ("tenant", req.tenant)
+        """The sharing boundary for `req`'s prefix-cache entries —
+        `serve.prefix.prefix_scope`, the one definition shared with the
+        DP router's session affinity (ISSUE 15)."""
+        from .prefix import prefix_scope
+
+        return prefix_scope(self.classes, req.klass, req.tenant)
 
     def _class_victims(self, head: Request) -> List[int]:
         """Slots holding in-flight work of a class STRICTLY below

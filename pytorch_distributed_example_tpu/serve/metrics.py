@@ -23,6 +23,19 @@ The PREFIX_CACHE block (ISSUE 12) is the sharing evidence: hit rate
 and prefix tokens reused (prefill compute + pool writes skipped),
 shared / copy-on-write-copied block counts, and pool bytes
 deduplicated vs a no-sharing layout (current gauge + peak).
+
+ROLLING WINDOWS (ISSUE 15): the autoscale controller must steer on
+what the engine did RECENTLY, not on lifetime aggregates — a lifetime
+SLO-attainment figure diluted by an hour of healthy traffic cannot see
+a breach that started thirty seconds ago, and a lifetime figure
+poisoned by one old incident never recovers, so a controller reading
+either would scale late in both directions. Every completion, step,
+and pool observation therefore also lands a TIMESTAMPED sample in a
+bounded deque, and `window_view(window_s)` reduces only the samples
+inside the trailing window: per-class completed / shed / SLO
+attainment / TTFT percentiles, queue-depth mean+max, slot occupancy,
+and pool utilization. `snapshot()` exposes the default-window view
+under ``window`` so ``/serve`` shows the controller's own evidence.
 """
 
 from __future__ import annotations
@@ -59,9 +72,11 @@ class ServeMetrics:
         slots: int = 0,
         max_latency_samples: int = 2048,
         classes: Optional[Dict[str, ClassSpec]] = None,
+        window_s: float = 30.0,
     ):
         self.clock = clock
         self.slots = slots
+        self.window_s = window_s  # default trailing window for views
         self._lock = threading.Lock()
         self._max_latency_samples = max_latency_samples
         self.submitted = 0
@@ -119,6 +134,12 @@ class ServeMetrics:
         self.ttft_s: deque = deque(maxlen=max_latency_samples)
         self.tpot_s: deque = deque(maxlen=max_latency_samples)
         self.e2e_s: deque = deque(maxlen=max_latency_samples)
+        # rolling-window sample streams (ISSUE 15): timestamped so a
+        # trailing-window reduction needs no extra bookkeeping at
+        # record time. Bounded like the latency deques — a window wider
+        # than what maxlen samples span simply reports what it has.
+        self._step_win: deque = deque(maxlen=2 * max_latency_samples)
+        self._pool_win: deque = deque(maxlen=2 * max_latency_samples)
         self._first_submit: Optional[float] = None
         self._last_complete: Optional[float] = None
 
@@ -135,6 +156,10 @@ class ServeMetrics:
                 "slo_met": 0,
                 "ttft": deque(maxlen=self._max_latency_samples),
                 "e2e": deque(maxlen=self._max_latency_samples),
+                # (t, ttft_s, slo_ok-or-None) completion samples for the
+                # trailing-window reduction; (t,) shed samples likewise
+                "win": deque(maxlen=self._max_latency_samples),
+                "shed_win": deque(maxlen=self._max_latency_samples),
             }
             self._by_class[klass] = st
         return st
@@ -166,6 +191,7 @@ class ServeMetrics:
                     k: int(sum(v)) for k, v in class_depths.items()
                 }
             self.peak_slots_active = max(self.peak_slots_active, slots_active)
+            self._step_win.append((self.clock(), queue_depth, slots_active))
             if self.slots:
                 self._occupancy_steps += slots_active / self.slots
 
@@ -178,7 +204,9 @@ class ServeMetrics:
         low-class request displaced by higher-class work."""
         with self._lock:
             self.shed += 1
-            self._class_state(klass)["shed"] += 1
+            st = self._class_state(klass)
+            st["shed"] += 1
+            st["shed_win"].append(self.clock())
 
     def record_preempt(self, n: int = 1, klass: str = DEFAULT_CLASS) -> None:
         """Pool-pressure evictions: requests requeued to free blocks."""
@@ -270,6 +298,9 @@ class ServeMetrics:
             if blocks_total:
                 self._pool_util_sum += blocks_live / blocks_total
                 self._pool_samples += 1
+                self._pool_win.append(
+                    (self.clock(), blocks_live / blocks_total)
+                )
             if live_requests > 0:
                 self._bytes_per_req_sum += (
                     blocks_live * bytes_per_block / live_requests
@@ -300,11 +331,87 @@ class ServeMetrics:
             st["ttft"].append(ttft_s)
             st["e2e"].append(e2e_s)
             spec = self._classes.get(klass)
+            slo_ok = None
             if spec is not None and spec.ttft_slo_s is not None:
-                st["slo_met"] += int(ttft_s <= spec.ttft_slo_s)
+                slo_ok = ttft_s <= spec.ttft_slo_s
+                st["slo_met"] += int(slo_ok)
+            st["win"].append((t, ttft_s, slo_ok))
             self._last_complete = t
 
     # -- reporting ---------------------------------------------------------
+    def _window_view_locked(
+        self, window_s: float, now: float
+    ) -> Dict:
+        """Trailing-window reduction (caller holds the lock). The shape
+        the autoscale controller steers on: per-class attainment over
+        samples with a defined SLO verdict (None when the window holds
+        no verdict — "no evidence" must be distinguishable from "SLO
+        perfect", or an idle trough would read as healthy forever),
+        plus queue/occupancy/pool-pressure means over the same window.
+        Bounded on BOTH sides — a replay with a historical `now` must
+        see exactly what the controller saw then, not samples from its
+        future."""
+        cutoff = now - window_s
+        by_class: Dict[str, Dict] = {}
+        for k, st in sorted(self._by_class.items()):
+            samples = [s for s in st["win"] if cutoff <= s[0] <= now]
+            verdicts = [s[2] for s in samples if s[2] is not None]
+            ttfts = [s[1] for s in samples]
+            by_class[k] = {
+                "completed": len(samples),
+                "shed": sum(
+                    1 for t in st["shed_win"] if cutoff <= t <= now
+                ),
+                # raw counts ride along so a multi-replica merger can
+                # sum them exactly instead of averaging ratios
+                "slo_met": sum(bool(v) for v in verdicts),
+                "slo_n": len(verdicts),
+                "slo_attainment": (
+                    round(sum(verdicts) / len(verdicts), 4)
+                    if verdicts
+                    else None
+                ),
+                "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 3),
+                "ttft_p99_ms": round(percentile(ttfts, 99) * 1e3, 3),
+            }
+        steps = [s for s in self._step_win if cutoff <= s[0] <= now]
+        pools = [s for s in self._pool_win if cutoff <= s[0] <= now]
+        n_steps = len(steps)
+        return {
+            "window_s": window_s,
+            "now": now,
+            "classes": by_class,
+            "steps": n_steps,
+            "queue_depth_mean": round(
+                sum(s[1] for s in steps) / n_steps, 3
+            ) if n_steps else 0.0,
+            "queue_depth_max": max((s[1] for s in steps), default=0),
+            "occupancy_mean": round(
+                sum(s[2] for s in steps) / (n_steps * self.slots), 4
+            ) if n_steps and self.slots else 0.0,
+            "pool_utilization_mean": round(
+                sum(u for _, u in pools) / len(pools), 4
+            ) if pools else 0.0,
+            "pool_utilization_max": round(
+                max((u for _, u in pools), default=0.0), 4
+            ),
+        }
+
+    def window_view(
+        self,
+        window_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Dict:
+        """Rolling-window view over the trailing `window_s` seconds
+        (default: the instance's `window_s`). `now` defaults to the
+        metrics clock — pass it explicitly to replay a recorded
+        decision against the exact snapshot that justified it."""
+        with self._lock:
+            return self._window_view_locked(
+                self.window_s if window_s is None else float(window_s),
+                self.clock() if now is None else float(now),
+            )
+
     def goodput_tokens_per_sec(self) -> float:
         """Completed-request tokens over the first-submit → last-complete
         window. 0 until at least one request completed."""
@@ -395,6 +502,11 @@ class ServeMetrics:
                 "peak_slots_active": self.peak_slots_active,
                 "mean_occupancy": round(occupancy, 4),
                 "tokens_completed": self.tokens_completed,
+                # the controller's evidence, on the same surface it
+                # polls — lifetime aggregates above, trailing window here
+                "window": self._window_view_locked(
+                    self.window_s, self.clock()
+                ),
                 "latency": lat,
                 "cache_pool": {
                     "blocks_live": self.pool_blocks_live,

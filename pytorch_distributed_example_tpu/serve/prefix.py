@@ -51,7 +51,26 @@ from __future__ import annotations
 
 from typing import Dict, Hashable, List, Optional, Sequence, Tuple
 
-__all__ = ["PrefixIndex"]
+__all__ = ["PrefixIndex", "prefix_scope"]
+
+
+def prefix_scope(classes, klass: str, tenant: str) -> Hashable:
+    """The sharing boundary for a request's prefix-cache entries: a
+    PRIVATE per-tenant scope unless the request's class opts into
+    cross-tenant sharing (`ClassSpec.share_prefix` — both sides of any
+    cross-tenant hit opted in by construction, since matching only ever
+    happens within one scope).
+
+    The ONE definition of the scope key: the engine's radix index and
+    the data-parallel router's session affinity (ISSUE 15) both key on
+    it, which is exactly what keeps a tenant's shared blocks hot on one
+    replica — the router cannot drift from the cache's tenancy model
+    because they call the same function."""
+    if classes is not None:
+        spec = classes.get(klass)
+        if spec is not None and spec.share_prefix:
+            return "*"
+    return ("tenant", tenant)
 
 
 class _Node:

@@ -239,6 +239,100 @@ class TestDynamicWorldSize:
         # the failure budget was charged once (joins are free)
         assert agent._failure_restarts == 1
 
+    def test_controller_resize_shrinks_then_grows(self, tmp_path):
+        """ISSUE 15: `request_resize` (the serve autoscaler's
+        out-of-process path) re-forms the LOCAL elastic gang at the
+        requested size at a generation boundary — shrink 4 -> 2, then
+        grow 2 -> 3 — with targets clamped to [min_nproc,
+        nproc_per_node] and the resize key consumed (no respawn loop)."""
+        import threading
+
+        from tests._mp_util import free_port
+
+        from pytorch_distributed_example_tpu.elastic import request_resize
+
+        script = _write(
+            tmp_path,
+            "worker.py",
+            f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO!r})
+            from pytorch_distributed_example_tpu.store import TCPStore
+
+            out = os.environ["OUT_DIR"]
+            gen = os.environ["TDX_RESTART_COUNT"]
+            rank = os.environ["RANK"]
+            world = int(os.environ["WORLD_SIZE"])
+            host, port = os.environ["TDX_AGENT_STORE"].rsplit(":", 1)
+            s = TCPStore(host, int(port), timeout=30.0)
+            s.add(f"gen{{gen}}/arrived", 1)
+            deadline = time.monotonic() + 30
+            while s.add(f"gen{{gen}}/arrived", 0) < world:
+                if time.monotonic() > deadline:
+                    sys.exit(5)
+                time.sleep(0.02)
+            with open(os.path.join(out, f"sync_g{{gen}}_w{{world}}_r{{rank}}"), "w") as f:
+                f.write("ok")
+            s.close()
+            stop = os.path.join(out, "STOP")
+            while not os.path.exists(stop):
+                time.sleep(0.02)
+            """,
+        )
+        port = free_port()
+        spec = WorkerSpec(
+            entrypoint=[script],
+            nproc_per_node=4,  # MAX
+            min_nproc=2,       # MIN
+            max_restarts=3,
+            monitor_interval_s=0.05,
+            master_port=port,
+            env={"OUT_DIR": str(tmp_path)},
+        )
+        agent = LocalElasticAgent(spec)
+        result = {}
+
+        def run():
+            result["res"] = agent.run()
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g0_w4_r{r}").exists() for r in range(4)
+                ),
+                what="gen0 gang of 4",
+            )
+            # controller asks for 1 — clamped to min_nproc=2
+            request_resize("127.0.0.1", port, 1)
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g1_w2_r{r}").exists() for r in range(2)
+                ),
+                what="gen1 gang of 2 (controller shrink, clamped)",
+            )
+            assert agent.active_nproc == 2
+            # grow back up mid-flight
+            request_resize("127.0.0.1", port, 3)
+            self._wait_for(
+                lambda: all(
+                    (tmp_path / f"sync_g2_w3_r{r}").exists() for r in range(3)
+                ),
+                what="gen2 gang of 3 (controller grow)",
+            )
+            assert agent.active_nproc == 3
+        finally:
+            (tmp_path / "STOP").write_text("1")
+            t.join(timeout=60)
+        assert not t.is_alive()
+        res = result["res"]
+        assert res.state is WorkerState.SUCCEEDED, res
+        # two controller resizes = two generations past 0, and neither
+        # consumed the FAILURE budget
+        assert res.restarts == 2, res
+        assert agent._failure_restarts == 0
+
     def test_below_min_fails(self, tmp_path):
         """Losing workers past MIN cannot meet quorum -> job fails."""
         script = _write(
