@@ -166,6 +166,163 @@ def replay(
             return steps
 
 
+def run_gang(args):
+    """``--gang``: the PROCESS-level replay (ISSUE 16) — a real
+    `LocalElasticAgent` gang of serve worker daemons
+    (`examples/serve_worker/main.py`) under live wall-clock traffic,
+    with the PR 14 `Autoscaler` driving `request_resize` through
+    `ElasticGangScaler`. Every completion is checked token-exact
+    against an uninterrupted in-process reference engine — resizes,
+    drains, and restores must be invisible in the tokens. Not
+    registered in run_all (wall-clock, multi-process); this is the
+    operator's smoke for a worker deployment."""
+    import os
+    import socket
+    import threading
+    import time as wall
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.common import emit
+    from pytorch_distributed_example_tpu.elastic.agent import (
+        LocalElasticAgent,
+        WorkerSpec,
+    )
+    from pytorch_distributed_example_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_example_tpu.serve import (
+        AutoscalePolicy,
+        Autoscaler,
+        ServeEngine,
+    )
+    from pytorch_distributed_example_tpu.serve.worker import (
+        ElasticGangScaler,
+        GangRouter,
+        wait_registered,
+    )
+    from pytorch_distributed_example_tpu.store import TCPStore
+
+    # worker geometry = the entrypoint's defaults (deterministic params
+    # from seed 0 on every rank, every generation)
+    vocab, max_seq = 64, 32
+    duration = min(args.duration, 30.0)
+    events = make_trace(
+        args.seed, duration, args.peak_x,
+        args.requests or int(duration * 3), args.tenants, vocab,
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["TDX_SERVE_CPU"] = "1"
+    width0 = min(2, args.max_replicas)
+    spec = WorkerSpec(
+        entrypoint=[
+            "examples/serve_worker/main.py", "--slots", str(args.slots),
+        ],
+        # capacity is the CEILING resizes clamp to; the gang FORMS at
+        # width0 (active_nproc below) so the autoscaler has headroom
+        # in both directions
+        nproc_per_node=args.max_replicas,
+        min_nproc=1,
+        master_port=port,
+        max_restarts=10,
+        serve_drain_grace_s=10.0,
+    )
+    agent = LocalElasticAgent(spec)
+    agent.active_nproc = width0
+    res = {}
+    th = threading.Thread(
+        target=lambda: res.update(run=agent.run()), daemon=True
+    )
+    th.start()
+    store = TCPStore("127.0.0.1", port, is_master=False, timeout=60.0)
+    wait_registered(store, 0, width0, timeout=120.0)
+    router = GangRouter(store)
+    scaler = Autoscaler(
+        ElasticGangScaler(router, "127.0.0.1", port),
+        AutoscalePolicy(
+            slo_floor=0.99,
+            queue_high=float(args.slots),
+            queue_low=0.5,
+            occupancy_low=0.5,
+            breach_polls=2,
+            cooldown_out_s=3.0,
+            cooldown_in_s=10.0,
+            max_step=1,
+            min_replicas=1,
+            max_replicas=args.max_replicas,
+        ),
+        window_s=5.0,
+    )
+    t0 = wall.monotonic()
+    try:
+        i, next_poll = 0, 0.0
+        while i < len(events):
+            now = wall.monotonic() - t0
+            while i < len(events) and events[i]["arrival"] <= now:
+                ev = events[i]
+                # gang workers run classless engines (the entrypoint's
+                # default) — tenancy rides along, class SLOs stay virtual
+                router.submit(
+                    ev["prompt"], ev["budget"], rid=ev["rid"],
+                    seed=ev["seed"], tenant=ev["tenant"],
+                )
+                i += 1
+            if now >= next_poll:
+                scaler.poll()
+                next_poll = now + 1.0
+            wall.sleep(0.02)
+        out = router.wait_all(timeout=240.0)
+        span = wall.monotonic() - t0
+    finally:
+        # even on failure: drop the sentinel so no worker outlives us
+        router.shutdown()
+        th.join(timeout=60.0)
+
+    # uninterrupted single-engine reference: resizes must be invisible
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=32, n_layers=2, n_heads=4,
+        max_seq_len=max_seq, use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    ref = ServeEngine(model, params, slots=args.slots)
+    for ev in events:
+        ref.submit(
+            np.asarray(ev["prompt"]), ev["budget"], rid=ev["rid"],
+            seed=ev["seed"], tenant=ev["tenant"],
+        )
+    ref_out = {r: list(c.tokens) for r, c in ref.run(500_000).items()}
+    mismatched = [r for r in ref_out if out.get(r) != ref_out[r]]
+    assert not mismatched, (
+        f"{len(mismatched)} requests token-diverged across the gang "
+        f"(e.g. {mismatched[:3]})"
+    )
+    run_res = res.get("run")
+    emit(
+        "serve_gang_token_exact_frac",
+        1.0,
+        "frac",
+        requests=len(events),
+        duration_wall_s=round(span, 2),
+        generations=getattr(run_res, "restarts", None),
+        resize_decisions=len(
+            [d for d in scaler.decisions if d.action != "hold"]
+        ),
+        final_state=str(getattr(run_res, "state", "?")),
+        slots=args.slots,
+        max_replicas=args.max_replicas,
+        seed=args.seed,
+        timing="wall_clock",
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", choices=sorted(PRESETS), default="tiny")
@@ -182,7 +339,15 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--step-cost-ms", type=float, default=50.0)
     ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--gang", action="store_true",
+                    help="process-level mode: a real elastic-agent gang "
+                         "of serve worker daemons under wall-clock "
+                         "traffic, autoscaler driving request_resize "
+                         "(ISSUE 16; not part of run_all)")
     args = ap.parse_args()
+    if args.gang:
+        run_gang(args)
+        return
 
     import jax
     import jax.numpy as jnp

@@ -299,6 +299,21 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # decision-to-first-token at a NEW gang width, pre-warmed
+            # (persistent cache + serialized executables) vs cold
+            # compile — the resize-latency row (ISSUE 16, >= 5x)
+            "serve_resize",
+            [sys.executable, "benchmarks/serve_resize.py"]
+            + (
+                ["--reps", "1"]
+                if q
+                else ["--reps", "2", "--d-model", "128", "--layers", "4",
+                      "--heads", "8", "--vocab", "256",
+                      "--max-seq-len", "64"]
+            ),
+            {},
+        ),
+        (
             # tensor-parallel decode goodput scaling 1 -> 2 chips
             # (ISSUE 6, >= 1.7x target on TPU; CPU runs are a virtual-
             # device wiring smoke, not a measurement)

@@ -182,7 +182,14 @@ _JOIN_KEY = "agent/join_waiting"  # NOT generation-namespaced: must survive re-f
 # Controller-requested gang size (request_resize): a single overwritten
 # target the agent consumes (deletes) at the generation boundary that
 # satisfies it — latest write wins, stale targets cannot replay.
+# Each write is stamped "nproc@seq" with a store-allocated monotonic
+# sequence; the agent persists the highest seq it ACTED on, so a
+# consumed key replayed after a generation bump (retrying proxy, torn
+# controller, duplicated set) is recognized as already-satisfied and
+# consumed as a no-op instead of driving a second resize.
 _RESIZE_KEY = "agent/resize_target"
+_RESIZE_SEQ_KEY = "agent/resize_seq"
+_RESIZE_DONE_KEY = "agent/resize_done_seq"
 _FATAL_KEY = "agent/fatal"
 
 # Agent -> serve-loop drain contract: the agent sets
@@ -230,6 +237,38 @@ def request_join(master_addr: str, master_port: int, timeout: float = 30.0) -> i
         s.close()
 
 
+def _stamp_resize(store, nproc: int) -> int:
+    """Publish a resize target stamped with a fresh store-allocated
+    sequence number. The counter is value-managed (monotonic allocator,
+    never reset); the stamped target key itself is consumed by the
+    agent at the generation boundary that satisfies it. Returns the
+    sequence assigned to this request."""
+    seq = store.add(_RESIZE_SEQ_KEY, 1)  # distlint: disable=R007 -- value-managed monotonic allocator; stamped targets carry the scope
+    store.set(_RESIZE_KEY, f"{int(nproc)}@{int(seq)}".encode())
+    return int(seq)
+
+
+def _parse_resize(raw: bytes):
+    """Decode a resize target -> (nproc, seq), either side None when
+    absent/garbage. Accepts the legacy unstamped form (a bare int,
+    seq None) for controllers predating the stamp."""
+    try:
+        text = raw.decode()
+    except (UnicodeDecodeError, AttributeError):
+        return None, None
+    target, sep, seq = text.partition("@")
+    try:
+        nproc = int(target)
+    except ValueError:
+        return None, None
+    if not sep:
+        return nproc, None
+    try:
+        return nproc, int(seq)
+    except ValueError:
+        return None, None  # torn/malformed stamp: treat whole value as garbage
+
+
 def request_resize(
     master_addr: str, master_port: int, nproc: int, timeout: float = 30.0
 ) -> None:
@@ -251,7 +290,7 @@ def request_resize(
         raise ValueError(f"nproc must be >= 1, got {nproc}")
     s = TCPStore(master_addr, master_port, is_master=False, timeout=timeout)
     try:
-        s.set(_RESIZE_KEY, str(int(nproc)).encode())
+        _stamp_resize(s, nproc)
     finally:
         s.close()
 
@@ -291,6 +330,9 @@ class LocalElasticAgent:
         self._advertise = self._compute_advertise()
         self.failovers = 0
         self._prev_world: Optional[int] = None  # agent.resize detector
+        # highest resize stamp acted on (lazy-loaded from the store so a
+        # restarted agent process still refuses replayed stamps)
+        self._resize_done: Optional[int] = None
 
     # -- store hosting -----------------------------------------------------
     def _ensure_store(self) -> Optional[TCPStore]:
@@ -568,36 +610,68 @@ class LocalElasticAgent:
 
     def _resize_target(self) -> Optional[int]:
         """The controller-requested LOCAL gang size, clamped to
-        [min_nproc, nproc_per_node]; None when absent or already
-        satisfied. A satisfied (or unparseable) target is consumed here
-        so the monitor cannot spin on a stale key."""
+        [min_nproc, nproc_per_node]; None when absent, already
+        satisfied, or a STALE replay (stamp at or below the persisted
+        acted-on high-water — a consumed key duplicated after a
+        generation bump must be a no-op, not a second resize). A
+        satisfied, stale, or unparseable target is consumed here so the
+        monitor cannot spin on it."""
         store = self._ensure_store()
         if store is None:
             return None
         raw = self._peek(store, _RESIZE_KEY)
         if raw is None:
             return None
-        target = self._clamp_resize(raw)
+        nproc, seq = _parse_resize(raw)
+        if seq is not None and seq <= self._resize_done_seq(store):
+            self._consume_resize_key(store, raw)  # replayed duplicate
+            return None
+        target = self._clamp_resize(nproc)
         if target == self.active_nproc:
             self._consume_resize_key(store, raw)
+            self._mark_resize_done(store, seq)
             return None
         return target
 
-    def _clamp_resize(self, raw: bytes) -> int:
-        try:
-            target = int(raw)
-        except ValueError:
-            target = self.active_nproc  # garbage target: treat as met
+    def _clamp_resize(self, nproc: Optional[int]) -> int:
+        if nproc is None:
+            nproc = self.active_nproc  # garbage target: treat as met
         return max(
             self.spec.min_nproc or 1,
-            min(target, self.spec.nproc_per_node),
+            min(nproc, self.spec.nproc_per_node),
         )
+
+    def _resize_done_seq(self, store) -> int:
+        """Highest resize stamp this supervision tree has acted on.
+        Persisted in the store (not just agent memory) so an agent
+        process that itself restarted still refuses replays of stamps
+        it satisfied in a previous life. Lazy-loaded once, then cached."""
+        if self._resize_done is None:
+            raw = self._peek(store, _RESIZE_DONE_KEY)
+            try:
+                self._resize_done = int(raw) if raw is not None else 0
+            except ValueError:
+                self._resize_done = 0
+        return self._resize_done
+
+    def _mark_resize_done(self, store, seq: Optional[int]) -> None:
+        """Advance the acted-on high-water mark (monotonic; unstamped
+        legacy targets carry no seq and advance nothing)."""
+        if seq is None or seq <= self._resize_done_seq(store):
+            return
+        self._resize_done = int(seq)
+        try:
+            store.set(_RESIZE_DONE_KEY, str(int(seq)).encode())  # distlint: disable=R007 -- single overwritten monotonic high-water; scope lives in the stamped values it tracks
+        except Exception:
+            pass  # in-memory mark still guards this process's lifetime
 
     def _consume_resize_key(self, store, acted_on: bytes) -> None:
         """Delete the resize target ONLY while it still holds the value
         just acted on — latest-write-wins means a NEWER target published
         meanwhile (the teardown window is seconds wide) must survive
-        for the next monitor tick, not be destroyed with the old one."""
+        for the next monitor tick, not be destroyed with the old one.
+        Stamped values make the exact-match test robust even when two
+        requests name the SAME nproc: their seqs differ."""
         try:
             cur = self._peek(store, _RESIZE_KEY)
             if cur is not None and cur == acted_on:
@@ -1332,16 +1406,26 @@ class LocalElasticAgent:
                         else None
                     )
                     if raw is not None:
-                        target = self._clamp_resize(raw)
-                        if target != self.active_nproc:
+                        nproc, seq = _parse_resize(raw)
+                        stale = (
+                            seq is not None
+                            and seq <= self._resize_done_seq(store)
+                        )
+                        target = self._clamp_resize(nproc)
+                        if not stale and target != self.active_nproc:
                             self._signal_drain()
                             self._stop_workers()
                             self.active_nproc = target
                             self._consume_resize_key(store, raw)
+                            self._mark_resize_done(store, seq)
                             self.restart_count += 1
                             self._start_workers()
                         else:
+                            # stale replay, garbage, or already met:
+                            # consume without re-forming the gang
                             self._consume_resize_key(store, raw)
+                            if not stale:
+                                self._mark_resize_done(store, seq)
                     continue
                 # failure: tear down the whole gang and re-rendezvous —
                 # surviving serve loops get the drain grace to checkpoint

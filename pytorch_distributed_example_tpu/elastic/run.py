@@ -66,6 +66,10 @@ def parse_args(argv=None):
                    help="this node's dialable address for the standby "
                         "store (defaults to a hostname lookup; loopback "
                         "when the rdzv endpoint is loopback)")
+    p.add_argument("--serve-drain-grace-s", type=float, default=0.0,
+                   help="seconds serve loops get to drain + checkpoint "
+                        "before a restart/resize teardown SIGTERMs them "
+                        "(serve worker deployments; 0 = no grace)")
     p.add_argument("--log-dir", type=str, default=None)
     p.add_argument("--no-python", action="store_true",
                    help="entrypoint is a raw command, not a python script")
@@ -144,6 +148,7 @@ def main(argv=None) -> int:
             master_port=master_port,
             raw_cmd=args.no_python,
             module=args.module,
+            serve_drain_grace_s=args.serve_drain_grace_s,
             store_failover=not args.no_store_failover,
             advertise_addr=args.advertise_addr,
         )

@@ -121,6 +121,30 @@ Named injection points wired in this package:
                                                     CHANGED world size —
                                                     shrink, grow, or node-
                                                     membership change)
+    serve.worker.start                             (serve worker daemon:
+                                                    process start, before any
+                                                    store key is touched — a
+                                                    transient fault retries in
+                                                    place; a crash respawns
+                                                    the gang at the same size
+                                                    and the store-backed work
+                                                    queue replays)
+    serve.worker.register                          (before the worker writes
+                                                    its generation-scoped
+                                                    registration key — fired
+                                                    with nothing registered,
+                                                    so a retried registration
+                                                    is idempotent)
+    serve.restore_geometry                         (before the re-formed
+                                                    gang's restore leader
+                                                    walks the per-rank
+                                                    snapshot planes and
+                                                    republishes them at the
+                                                    NEW geometry — fired with
+                                                    nothing republished, so a
+                                                    transient fault retries
+                                                    and a crash defers to the
+                                                    next generation's leader)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -215,6 +239,9 @@ KNOWN_POINTS = frozenset({
     "serve.scale_in",
     "router.route",
     "agent.resize",
+    "serve.worker.start",
+    "serve.worker.register",
+    "serve.restore_geometry",
     "train.step",
 })
 

@@ -55,6 +55,7 @@ from .decode import (  # noqa: F401
 )
 from .elastic import (  # noqa: F401
     drain_requested,
+    gc_serve_state,
     load_serve_state,
     restore_into,
     save_serve_state,
@@ -69,6 +70,18 @@ from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
 from .prefix import PrefixIndex, prefix_scope  # noqa: F401
 from .router import ScaleEvent, ServeRouter  # noqa: F401
+from .worker import (  # noqa: F401
+    ElasticGangScaler,
+    GangRouter,
+    ServeWorker,
+    wait_registered,
+)
+from .prewarm import (  # noqa: F401
+    GeometrySpec,
+    enable_compile_cache,
+    prewarm_engine_programs,
+    reachable_geometries,
+)
 from .queue import (  # noqa: F401
     ClassSpec,
     Completion,

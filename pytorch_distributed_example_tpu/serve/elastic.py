@@ -53,6 +53,7 @@ from .queue import Request
 __all__ = [
     "save_serve_state",
     "load_serve_state",
+    "gc_serve_state",
     "restore_into",
     "drain_requested",
     "signal_drain",
@@ -161,6 +162,40 @@ def load_serve_state(
             stacklevel=2,
         )
     return None, -1
+
+
+def gc_serve_state(
+    store,
+    verified_gen: int,
+    keep: int = 2,
+    key_prefix: str = SERVE_CKPT_PREFIX,
+    max_scan: int = 32,
+) -> int:
+    """Reclaim sealed generation blobs the fallback chain can no longer
+    need: every generation strictly older than ``verified_gen - keep``.
+
+    `verified_gen` must be a generation that VERIFIED on read-back (the
+    one `load_serve_state` just returned) — GC anchored on the latest
+    pointer instead would let a torn/corrupt newest blob strand the
+    plane with nothing restorable once its predecessors are reclaimed.
+    Keeping `keep` generations below the verified one preserves the
+    CRC-fallback property across the next few seals: if the NEXT
+    sealed generation lands corrupt, `load_serve_state` still walks
+    back onto blobs this GC was forbidden to touch. Returns the number
+    of blobs reclaimed; never raises (a flaky store just defers the
+    reclaim to the next restore)."""
+    if verified_gen < 0 or keep < 0:
+        return 0
+    floor = int(verified_gen) - int(keep)  # oldest generation KEPT
+    reclaimed = 0
+    for gen in range(floor - 1, max(floor - 1 - int(max_scan), -1), -1):
+        key = _ckpt_key(gen, key_prefix)
+        try:
+            if store.check([key]) and store.delete_key(key):
+                reclaimed += 1
+        except Exception:
+            break  # store trouble: stop here, retry at the next restore
+    return reclaimed
 
 
 def restore_into(engine, state: Dict, generation: int = -1) -> int:

@@ -163,6 +163,7 @@ class ServeEngine:
         classes: Optional[Dict[str, ClassSpec]] = None,
         class_preemption: bool = True,
         prefix_cache: bool = False,
+        precompiled=None,
     ):
         self.model = model
         self.params = params["params"] if "params" in params else params
@@ -231,6 +232,27 @@ class ServeEngine:
             self._attach,
             self._step,
         ) = paged_programs(model, temperature, top_k)
+        if precompiled:
+            # resize fast path (serve/prewarm.py): overlay pre-warmed
+            # executables — matching shapes skip trace AND compile,
+            # everything else falls through to the jit quadruple
+            from .prewarm import attach_precompiled
+
+            (
+                self._prefill_chunk,
+                self._first_token,
+                self._attach,
+                self._step,
+            ) = attach_precompiled(
+                (
+                    self._prefill_chunk,
+                    self._first_token,
+                    self._attach,
+                    self._step,
+                ),
+                precompiled,
+                slots,
+            )
         S = slots
         self._slot_req: List[Optional[Request]] = [None] * S
         self._slot_tokens: List[List[int]] = [[] for _ in range(S)]

@@ -632,6 +632,47 @@ class TestElasticServe:
         finally:
             faults.clear_plan()
 
+    def test_gc_keeps_fallback_chain_under_corrupt_newest(self):
+        """Snapshot-generation GC must stay anchored on the newest
+        VERIFIED generation: after reclaiming, a corrupt newest blob
+        still falls back onto a sealed predecessor GC was forbidden to
+        touch."""
+        from pytorch_distributed_example_tpu.serve.elastic import (
+            gc_serve_state,
+            load_serve_state,
+            save_serve_state,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        faults.clear_plan()
+        s = HashStore(timeout=1.0)
+        for g in range(4):
+            save_serve_state(
+                s, g, {"requests": [], "emitted": {},
+                       "checkpoint_time": float(g)}
+            )
+        st, g = load_serve_state(s)
+        assert g == 3
+        # verified=3, keep=2 -> generations {1, 2, 3} stay; only gen0 goes
+        assert gc_serve_state(s, g, keep=2) == 1
+        assert not s.check(["serve/ckpt/gen0"])
+        for kept in (1, 2, 3):
+            assert s.check([f"serve/ckpt/gen{kept}"])
+        # idempotent: nothing below the floor remains
+        assert gc_serve_state(s, g, keep=2) == 0
+        # corrupt the newest AFTER the reclaim — the fallback chain GC
+        # preserved still restores gen2
+        s.set("serve/ckpt/gen3", b"not a sealed blob")
+        with pytest.warns(RuntimeWarning, match="CRC"):
+            st, g = load_serve_state(s)
+        assert g == 2 and st["checkpoint_time"] == 2.0
+        # and GC anchored on THAT verified gen keeps its own margin
+        assert gc_serve_state(s, g, keep=2) == 0
+        assert s.check(["serve/ckpt/gen1"])
+        # degenerate inputs are no-ops, never raises
+        assert gc_serve_state(s, -1) == 0
+        assert gc_serve_state(s, 2, keep=-1) == 0
+
     def test_drain_signalling_helpers(self):
         from pytorch_distributed_example_tpu.serve.elastic import (
             drain_requested,
