@@ -244,7 +244,7 @@ def _stamp_resize(store, nproc: int) -> int:
     agent at the generation boundary that satisfies it. Returns the
     sequence assigned to this request."""
     seq = store.add(_RESIZE_SEQ_KEY, 1)  # distlint: disable=R007 -- value-managed monotonic allocator; stamped targets carry the scope
-    store.set(_RESIZE_KEY, f"{int(nproc)}@{int(seq)}".encode())
+    store.set(_RESIZE_KEY, f"{int(nproc)}@{int(seq)}".encode())  # distlint: disable=R007 -- consumed by CAS-tombstone (compare_set to b"" in _consume_resize_key), not delete_key: the unguarded delete was a stamp-destroying TOCTOU
     return int(seq)
 
 
@@ -395,6 +395,7 @@ class LocalElasticAgent:
         return port
 
     def _start_workers(self) -> None:
+        self._gc_drain_keys()
         if self.spec.node_elastic and self._active_master != (
             self.spec.master_addr, self.spec.master_port
         ):
@@ -508,6 +509,25 @@ class LocalElasticAgent:
             proc = subprocess.Popen(argv, env=env, stdout=stdout, stderr=stderr)
             self._workers.append(_Worker(r, proc, WorkerState.HEALTHY))
 
+    def _gc_drain_keys(self, back: int = 8) -> None:
+        """Reclaim drain signals from retired generations. A drain key
+        is consumed the moment its generation's workers exit, but the
+        row itself outlived the gang (one leaked key per resize/restart
+        for the store-daemon lifetime — flagged by storelint S005).
+        Swept when the NEXT generation's workers start: by then nothing
+        can still poll the old scope. Bounded back-scan; node 0 and
+        peers deleting the same keys is an idempotent race."""
+        if self.restart_count <= 0:
+            return
+        store = self._ctrl if self._ctrl is not None else self._store
+        if store is None:
+            return
+        for g in range(max(0, self.restart_count - back), self.restart_count):
+            try:
+                store.delete_key(f"{SERVE_DRAIN_PREFIX}/gen{g}")
+            except Exception:
+                return  # store unreachable: the next start retries
+
     def _signal_drain(self) -> None:
         """Serve-aware teardown: publish the generation-scoped drain key
         and wait (up to `serve_drain_grace_s`) for serve loops to
@@ -620,8 +640,8 @@ class LocalElasticAgent:
         if store is None:
             return None
         raw = self._peek(store, _RESIZE_KEY)
-        if raw is None:
-            return None
+        if raw is None or raw == b"":
+            return None  # absent, or a consumed-stamp tombstone
         nproc, seq = _parse_resize(raw)
         if seq is not None and seq <= self._resize_done_seq(store):
             self._consume_resize_key(store, raw)  # replayed duplicate
@@ -666,16 +686,21 @@ class LocalElasticAgent:
             pass  # in-memory mark still guards this process's lifetime
 
     def _consume_resize_key(self, store, acted_on: bytes) -> None:
-        """Delete the resize target ONLY while it still holds the value
+        """Retire the resize target ONLY while it still holds the value
         just acted on — latest-write-wins means a NEWER target published
         meanwhile (the teardown window is seconds wide) must survive
         for the next monitor tick, not be destroyed with the old one.
         Stamped values make the exact-match test robust even when two
-        requests name the SAME nproc: their seqs differ."""
+        requests name the SAME nproc: their seqs differ.
+
+        Atomic via `compare_set` to an empty tombstone (the old
+        peek-then-delete pair had a window where a stamp published
+        between the two ops was destroyed — found by the storelint
+        resize interleaving scenario). `_resize_target` treats the
+        empty value as absent, so the tombstone never reaches the
+        parser."""
         try:
-            cur = self._peek(store, _RESIZE_KEY)
-            if cur is not None and cur == acted_on:
-                store.delete_key(_RESIZE_KEY)
+            store.compare_set(_RESIZE_KEY, acted_on, b"")  # storelint: disable=S006 -- one-shot by contract: losing this race means a newer stamp landed and must survive
         except Exception:
             pass  # best-effort GC; re-read next tick is harmless
 
@@ -706,7 +731,7 @@ class LocalElasticAgent:
             return "done"
         gen = self.restart_count
         try:
-            ctrl.set(f"agent/done/gen{gen}/node{self.spec.node_rank}", b"1")
+            ctrl.set(f"agent/done/gen{gen}/node{self.spec.node_rank}", b"1")  # storelint: disable=S005 -- final-generation teardown handshake; the rank-0 store daemon dies right after
         except Exception:
             return "fatal"
         deadline = time.monotonic() + self.spec.peer_done_timeout_s
@@ -724,7 +749,7 @@ class LocalElasticAgent:
                 # observation of the done keys — node 0 returning first
                 # would close the daemon while others still poll it
                 try:
-                    ctrl.set(
+                    ctrl.set(  # storelint: disable=S005 -- two-phase teardown ack; nothing outlives the daemon these rows protect
                         f"agent/done_ack/gen{gen}/node{self.spec.node_rank}",
                         b"1",
                     )
@@ -764,7 +789,7 @@ class LocalElasticAgent:
             _mark_fatal(ctrl)
             return False
         self.restart_count = target
-        ctrl.set(f"agent/gen{target}/ready/{self.spec.node_rank}", b"1")
+        ctrl.set(f"agent/gen{target}/ready/{self.spec.node_rank}", b"1")  # storelint: disable=S005 -- restart rendezvous rows; straggler nodes re-read old generations, so only daemon death reclaims them
         try:
             ctrl.wait(
                 [
@@ -876,7 +901,7 @@ class LocalElasticAgent:
             self._peer_endpoints[self.spec.node_rank] = me
             val += f"|{me[0]}:{me[1]}"
         try:
-            ctrl.set(self._hb_key(self.spec.node_rank), val)
+            ctrl.set(self._hb_key(self.spec.node_rank), val)  # storelint: disable=S005 -- per-node heartbeat row overwritten in place; staleness IS the liveness signal, deletion would erase it
         except Exception:
             pass  # store host gone; staleness/fatal paths will decide
 
@@ -1068,7 +1093,7 @@ class LocalElasticAgent:
         proposal_set = sorted(ready | set(self._fresh_hb_nodes(ctrl)))
         proposal = ",".join(str(n) for n in proposal_set).encode()
         try:
-            published = ctrl.compare_set(
+            published = ctrl.compare_set(  # storelint: disable=S005,S006 -- one-shot election per generation: losers ADOPT the published proposal (no rescan by design), and the row must stay readable for the whole gen
                 f"agent/gen{target}/members", b"", proposal
             )
         except Exception:

@@ -145,6 +145,14 @@ Named injection points wired in this package:
                                                     transient fault retries
                                                     and a crash defers to the
                                                     next generation's leader)
+    serve.worker.gc                                (before the restore leader
+                                                    sweeps retired-generation
+                                                    registration rows and
+                                                    restore markers — fired
+                                                    with nothing deleted, so
+                                                    a retried or abandoned
+                                                    sweep is idempotent; the
+                                                    next leader re-walks it)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -242,6 +250,7 @@ KNOWN_POINTS = frozenset({
     "serve.worker.start",
     "serve.worker.register",
     "serve.restore_geometry",
+    "serve.worker.gc",
     "train.step",
 })
 

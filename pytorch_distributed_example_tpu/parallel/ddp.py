@@ -246,6 +246,85 @@ def _live_param_names(fn, params, *args) -> Tuple[list, list]:
     return used, unused
 
 
+# Transform names whose update couples elements ACROSS a leaf (or across
+# the whole tree): slicing params 1/W per rank changes what the coupled
+# reduction sees, so the ZeRO sharded update is no longer bitwise the
+# replicated one. Keyed by the optax factory name recovered from the
+# transform's closure qualnames.
+_COUPLING_KINDS = {
+    "scale_by_factored_rms": "factored",      # adafactor's v_row/v_col
+    "clip_by_global_norm": "global_norm",     # one norm over the TREE
+    "scale_by_trust_ratio": "per_leaf_norm",  # lamb / lars ||p||,||u||
+    "clip_by_block_rms": "per_leaf_norm",
+    "adaptive_grad_clip": "per_leaf_norm",    # AGC unit-wise norms
+}
+
+
+def _walk_transform_names(obj, out: set, depth: int = 0, seen=None) -> None:
+    """Collect the factory names of every optax transform reachable
+    from `obj`. A chained transform's init/update close over tuples of
+    the sub-transforms' FUNCTIONS (possibly wrapped —
+    `with_extra_args_support.<locals>.update`), so the walk recurses
+    through function closures; each leaf function is a `<locals>` of
+    the factory that built it (`scale_by_adam.<locals>.update_fn` →
+    `scale_by_adam`)."""
+    if depth > 10 or obj is None:
+        return
+    if seen is None:
+        seen = set()
+    fns = [
+        f
+        for f in (getattr(obj, "init", None), getattr(obj, "update", None))
+        if callable(f)
+    ]
+    if not fns and callable(obj):
+        fns = [obj]
+    for fn in fns:
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        root = getattr(fn, "__qualname__", "").split(".")[0]
+        if root:
+            out.add(root)
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue  # unfilled cell
+            items = v if isinstance(v, (tuple, list)) else (v,)
+            for item in items:
+                if hasattr(item, "init") and hasattr(item, "update"):
+                    _walk_transform_names(item, out, depth + 1, seen)
+                elif callable(item):
+                    _walk_transform_names(item, out, depth + 1, seen)
+
+
+def classify_update_coupling(optimizer) -> Tuple[str, list]:
+    """Best-effort STRUCTURAL classification of an optax chain for the
+    ZeRO sharded weight update: does any transform couple elements
+    across a leaf? Returns `(kind, hits)` where kind is
+    ``"elementwise"`` (no coupling marker found — sgd/momentum/adam/
+    adamw chains), ``"factored"`` (adafactor-style factored state —
+    also caught shape-structurally by the step itself),
+    ``"global_norm"`` (one norm over the whole tree, e.g.
+    `clip_by_global_norm`), ``"per_leaf_norm"`` (whole-leaf norms, the
+    lamb/lars trust-ratio family) or ``"unknown"`` (nothing walkable —
+    a non-optax optimizer), and hits names the offending factories.
+    Purely an inspection — callers decide whether to warn or raise."""
+    names: set = set()
+    _walk_transform_names(optimizer, names)
+    if not names:
+        return "unknown", []
+    hits = sorted(n for n in names if n in _COUPLING_KINDS)
+    if not hits:
+        return "elementwise", []
+    kinds = {_COUPLING_KINDS[n] for n in hits}
+    for kind in ("factored", "global_norm", "per_leaf_norm"):
+        if kind in kinds:
+            return kind, hits
+    return "elementwise", []
+
+
 def make_ddp_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -313,13 +392,15 @@ def make_ddp_train_step(
     (sgd/momentum/adam/adamw — each element's update depends only on
     its own history). Optimizers that couple elements across a leaf
     need ``shard_weight_update="off"``: adafactor's factored moments
-    are DETECTED (auto falls back with a warning, force raises), but
-    norm-coupled updates whose state is param-shaped are NOT detectable
-    from structure — global-norm clipping (stateless) and the per-leaf
-    trust-ratio family (optax.lamb / lars / fromage read whole-leaf
-    norms) train silently wrong on shards; pass "off" for those
-    yourself. "off" is the pre-ZeRO replicated update; "force" builds
-    the sharded program even at world 1.
+    are DETECTED from state shapes (auto falls back with a warning,
+    force raises), and norm-coupled transforms whose state is
+    param-shaped — global-norm clipping, the lamb/lars trust-ratio
+    family — are detected CHAIN-structurally by
+    `classify_update_coupling` (the factory names survive in the optax
+    chain's closures) and warned about at build time; they still run
+    sharded, so pass "off" yourself when the warning applies. "off" is
+    the pre-ZeRO replicated update; "force" builds the sharded program
+    even at world 1.
     """
     import jax
     from jax import lax
@@ -352,6 +433,28 @@ def make_ddp_train_step(
 
     if isinstance(optimizer, ZeroRedundancyOptimizer):
         optimizer = optimizer.optimizer
+    if zero_update:
+        # chain-structural elementwise-ness check (ROADMAP carried
+        # follow-on): norm-coupled transforms whose STATE is param-
+        # shaped leave no shape trace for _zero_resolved, but their
+        # factory names survive in the chain's closures. Warn-only —
+        # the operator may know the coupling is tolerable (e.g. a clip
+        # that never activates); factored state stays the structural
+        # detector's business (fallback/raise, not just a warning).
+        _kind, _hits = classify_update_coupling(optimizer)
+        if _kind in ("global_norm", "per_leaf_norm"):
+            import warnings
+
+            warnings.warn(
+                "shard_weight_update: optimizer chain contains "
+                f"{', '.join(_hits)} — a {_kind.replace('_', '-')} "
+                "coupled transform reads norms a 1/W param shard "
+                "cannot see, so the ZeRO sharded update is NOT exact "
+                "for it; pass shard_weight_update='off' unless the "
+                "coupling is tolerable",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     hook = comm_hook
     if hook is None:
         # planner-aware default: when the topology-aware collective
@@ -592,11 +695,11 @@ def make_ddp_train_step(
         Geometry-coupled state (adafactor's factored v_row/v_col) is
         detectable: a non-scalar state leaf shaped unlike every param
         leaf. On detection, "auto" falls back to the replicated update
-        with ONE warning; "force" raises. (Coupling with no structural
+        with ONE warning; "force" raises. (Coupling with no SHAPE
         trace — clip_by_global_norm's stateless global norm, the
-        lamb/lars/fromage trust ratios over param-shaped state — cannot
-        be seen from here; that limitation is documented at the factory
-        and in the README, not detected.)"""
+        lamb/lars trust ratios over param-shaped state — cannot be
+        seen from here; `classify_update_coupling` catches those
+        chain-structurally at build time and warns.)"""
         nonlocal zero_update
         if not zero_update:
             return False

@@ -110,7 +110,7 @@ def save_serve_state(
     store.set(key, _seal(dict(state, generation=int(gen))))
     # the pointer is a single overwritten key (the incarnation scope
     # lives in the per-generation blobs it points AT)
-    store.set(f"{key_prefix}/latest", str(int(gen)).encode())
+    store.set(f"{key_prefix}/latest", str(int(gen)).encode())  # storelint: disable=S005 -- single overwritten per-plane pointer; the CRC-fallback walk anchors on it, and the gens below it ARE GC'd
     return key
 
 

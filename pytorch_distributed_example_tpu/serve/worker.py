@@ -185,6 +185,35 @@ def wait_registered(
         time.sleep(0.02)
 
 
+def gc_worker_state(store, gen: int, keep: int = 2, back: int = 16) -> int:
+    """Reclaim per-generation coordination rows from retired gangs:
+    worker registration rows (`serve/worker/gen{g}/rank{r}`) and
+    leader-election restore markers (`serve/restored/gen{g}`[+`/done`])
+    older than the newest `keep` generations. Without this every
+    resize leaked one marker pair plus one row per rank for the store
+    daemon's lifetime (storelint S005). Called by the restore leader —
+    exactly one walker per generation, and by the time gen G's leader
+    runs, nothing can still poll a scope older than G-1 (followers of
+    a LIVE generation poll only their own marker). Returns the number
+    of keys deleted; best-effort, a partial sweep is retried by the
+    next generation's leader."""
+    _fire_with_retry("serve.worker.gc", gen=gen)
+    deleted = 0
+    floor = gen - keep + 1
+    for g in range(max(0, gen - back), max(0, floor)):
+        try:
+            for r in range(_MAX_RANKS):
+                if store.delete_key(_reg_key(g, r)):
+                    deleted += 1
+            if store.delete_key(f"serve/restored/gen{g}"):
+                deleted += 1
+            if store.delete_key(f"serve/restored/gen{g}/done"):
+                deleted += 1
+        except Exception:
+            return deleted
+    return deleted
+
+
 class ServeWorker:
     """One gang member's serve daemon: claim → serve → publish, with
     the drain/seal/restore lifecycle at generation boundaries.
@@ -303,6 +332,10 @@ class ServeWorker:
             self.store.set(f"{marker}/done", b"1")
         except Exception:
             pass  # followers fall through their bounded wait
+        try:
+            gc_worker_state(self.store, self.gen)
+        except Exception:
+            pass  # reclaim is deferred to the next generation's leader
 
     def _claim_restored(self, rid: str) -> None:
         """Stamp this generation's claim for a snapshot-adopted rid (via
@@ -316,7 +349,7 @@ class ServeWorker:
         except Exception:
             return
         try:
-            self.store.set(
+            self.store.set(  # storelint: disable=S005 -- generation-scoped claims must outlive their gen for replay dedup; every historical gen would need sweeping, so only store death reclaims them
                 _claim_key(self.gen, seq), str(self.rank).encode()
             )
             self._claimed.add(seq)
@@ -348,6 +381,21 @@ class ServeWorker:
         raise DistError(
             f"rank{self.rank}: registration kept failing at gen{self.gen}"
         )
+
+    def _deregister(self) -> None:
+        """Terminal-exit counterpart of `_register`: remove this
+        worker's membership row and live metrics row so a shut-down
+        plane leaves no stale gang view behind (drained generations
+        instead leave the rows for `gc_worker_state`, because the NEXT
+        generation's restore wants the old geometry visible)."""
+        for key in (
+            _reg_key(self.gen, self.rank),
+            f"serve/metrics/rank{self.rank}",
+        ):
+            try:
+                self.store.delete_key(key)
+            except Exception:
+                return  # best-effort: the router's sweep also covers us
 
     # -- ledger ------------------------------------------------------------
     def _is_done(self, rid: str) -> bool:
@@ -460,7 +508,7 @@ class ServeWorker:
             }
         ).encode()
         try:
-            self.store.set(f"serve/metrics/rank{self.rank}", row)  # distlint: disable=R007 -- single overwritten live row; readers filter staleness by timestamp
+            self.store.set(f"serve/metrics/rank{self.rank}", row)
         except Exception:
             pass
 
@@ -480,6 +528,7 @@ class ServeWorker:
                 if self.store.check([_SHUTDOWN_KEY]):
                     self._publish_completions()
                     self._publish_metrics(force=True)
+                    self._deregister()
                     return "shutdown"
             except Exception:
                 pass
@@ -669,12 +718,29 @@ class GangRouter:
             ),
         }
 
+    def members(self, gen: int) -> List[Dict]:
+        """The registration rows of generation `gen` — the controller's
+        view of a formed gang (pid, rank, slots, geometry) without the
+        blocking semantics of `wait_registered`."""
+        rows: List[Dict] = []
+        for r in range(_MAX_RANKS):
+            key = _reg_key(gen, r)
+            try:
+                if not self.store.check([key]):
+                    continue
+                rows.append(json.loads(self.store.get(key)))
+            except Exception:
+                continue
+        return rows
+
     # -- teardown ----------------------------------------------------------
     def shutdown(self, sweep: bool = True) -> None:
         """Terminal: ask every worker to exit 0 (the agent then reads
         the all-zero gang as SUCCEEDED) and sweep this router's
-        rid-addressed keys — the reclaim half of the `serve/done` and
-        `serve/work/rid` namespaces."""
+        rid-addressed keys — the reclaim half of the `serve/done`,
+        `serve/work/rid`, `serve/work/item` and `serve/metrics`
+        namespaces (item seqs resolved through the rid index BEFORE the
+        index rows are dropped)."""
         try:
             self.store.set(_SHUTDOWN_KEY, b"1")  # distlint: disable=R007 -- terminal shutdown sentinel; outliving the last generation is the point
         except Exception:
@@ -683,8 +749,16 @@ class GangRouter:
             return
         for rid in self._rids:
             try:
+                if self.store.check([_rid_key(rid)]):
+                    seq = int(self.store.get(_rid_key(rid)).decode())
+                    self.store.delete_key(_item_key(seq))
                 self.store.delete_key(_done_key(rid))
                 self.store.delete_key(_rid_key(rid))
+            except Exception:
+                break
+        for r in range(_MAX_RANKS):
+            try:
+                self.store.delete_key(f"serve/metrics/rank{r}")
             except Exception:
                 break
 

@@ -18,11 +18,13 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import socket
 import struct
+import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Mapping, Optional, Tuple
 
 from . import faults, traceguard
 from .types import DistStoreError, DistTimeoutError
@@ -87,11 +89,48 @@ class Store:
         rnd = self._barrier_rounds.get(tag, 0)
         self._barrier_rounds[tag] = rnd + 1
         key = f"__barrier/{tag}/{rnd}"
-        arrived = self.add(key, 1)
+        arrived = self.add(key, 1)  # storelint: disable=S005 -- round-keyed barrier rows: a late waiter may still poll round N after N+1 forms, deletion would hang it
         sense = f"{key}/done"
         if arrived == world_size:
-            self.set(sense, b"1")
+            self.set(sense, b"1")  # storelint: disable=S005 -- sense key of the round above; same late-waiter hazard
         self.wait([sense], timeout)
+
+
+_DUMP_ENV = "TDX_STORE_DUMP"
+_NUM_RUN_RE = re.compile(r"\d+")
+
+
+def key_families(data: Mapping[str, bytes]) -> Dict[str, Tuple[int, int]]:
+    """Collapse a live key map into normalized families (digit runs →
+    `{n}`): family → (key count, total value bytes). The runtime
+    counterpart of storelint's static key registry — a family that
+    only ever grows here is a coordination leak."""
+    fams: Dict[str, List[int]] = {}
+    for k, v in data.items():
+        row = fams.setdefault(_NUM_RUN_RE.sub("{n}", k), [0, 0])
+        row[0] += 1
+        row[1] += len(v)
+    return {f: (c, b) for f, (c, b) in fams.items()}
+
+
+def dump_key_families(data: Mapping[str, bytes], label: str = "store") -> None:
+    """`TDX_STORE_DUMP=1` teardown observability: print the live key
+    families (largest first) when a store daemon closes, so a leaked
+    family is visible in any test or deployment log without a
+    debugger. No-op unless the env knob is set."""
+    if os.environ.get(_DUMP_ENV, "") != "1":
+        return
+    fams = key_families(data)
+    lines = [
+        f"[{_DUMP_ENV}] {label}: {sum(c for c, _ in fams.values())} live "
+        f"key(s) in {len(fams)} famil{'y' if len(fams) == 1 else 'ies'} "
+        "at teardown"
+    ]
+    for fam, (count, nbytes) in sorted(
+        fams.items(), key=lambda kv: (-kv[1][0], kv[0])
+    ):
+        lines.append(f"  {count:>5} key(s) {nbytes:>9}B  {fam}")
+    sys.stderr.write("\n".join(lines) + "\n")
 
 
 def _to_bytes(v) -> bytes:
@@ -169,6 +208,11 @@ class HashStore(Store):
     def num_keys(self):
         with self._lock:
             return len(self._data)
+
+    def close(self):
+        with self._lock:
+            snapshot = dict(self._data)
+        dump_key_families(snapshot, label="HashStore")
 
 
 class FileStore(Store):
@@ -520,7 +564,7 @@ class TCPStore(Store):
         gen = os.environ.get("TDX_RESTART_COUNT", "0") or "0"
         join_key = f"__init/worker_count/gen{gen}"
         if world_size > 0 and not is_master:
-            self.add(join_key, 1)
+            self.add(join_key, 1)  # storelint: disable=S005 -- generation-scoped join counter read by the daemon host; dies with the store it gates
         if is_master and wait_for_workers and world_size > 1:
             deadline = time.monotonic() + self.timeout
             while int(self._call(_CMD_ADD, join_key, b"0").decode()) < world_size - 1:
@@ -721,6 +765,11 @@ class TCPStore(Store):
                 self._native_client = None
         finally:
             if self._daemon is not None:
+                with self._daemon._lock:
+                    snapshot = dict(self._daemon._data)
+                dump_key_families(
+                    snapshot, label=f"TCPStore(:{self.port})"
+                )
                 self._daemon.stop()
             if self._native_daemon is not None:
                 self._lib.tdx_store_server_stop(self._native_daemon)

@@ -1,0 +1,19 @@
+"""S001 fixture: waited-on key family with no producer anywhere."""
+
+
+def hangs_forever(store):
+    # POSITIVE: no function in this project ever writes job/phantom/*
+    store.wait(["job/phantom/ready"])
+
+
+def waits_fine(store):
+    # NEGATIVE: producer below writes the same family
+    store.wait(["job/real/ready"])
+
+
+def produces(store):
+    store.set("job/real/ready", b"1")
+
+
+def consumes(store):
+    return store.get("job/real/ready")

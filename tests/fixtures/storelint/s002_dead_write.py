@@ -1,0 +1,15 @@
+"""S002 fixture: written key family no one ever reads back."""
+
+
+def dead_write(store):
+    # POSITIVE: audit/blob is never read, waited on, or deleted
+    store.set("audit/blob", b"x")
+
+
+def live_write(store):
+    # NEGATIVE: read back below
+    store.set("audit/live", b"x")
+
+
+def live_read(store):
+    return store.get("audit/live")

@@ -1,0 +1,26 @@
+"""S003 fixture: producer and consumer disagree on the key format
+within one family base — the templates can never meet."""
+
+
+def writes_rank_style(store, rank):
+    # POSITIVE: writer says result/rank{r}, waiter says result/node{r}
+    store.set(f"result/rank{rank}", b"done")
+
+
+def waits_node_style(store, rank):
+    store.wait([f"result/node{rank}"])
+
+
+def writes_matching(store, rank):
+    # NEGATIVE: both sides agree on stats/rank{r}
+    store.set(f"stats/rank{rank}", b"done")
+
+
+def waits_matching(store, rank):
+    store.wait([f"stats/rank{rank}"])
+
+
+def gc_results(store, rank):
+    store.delete_key(f"result/rank{rank}")
+    store.delete_key(f"result/node{rank}")
+    store.delete_key(f"stats/rank{rank}")

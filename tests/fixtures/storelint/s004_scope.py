@@ -1,0 +1,25 @@
+"""S004 fixture: one side of a family is generation-scoped, the other
+is not — a restart changes gen and the sides never meet again."""
+
+
+def writes_scoped(store, gen):
+    # POSITIVE: writer scopes by gen, waiter below does not
+    store.set(f"phase/flag/gen{gen}", b"1")
+
+
+def waits_unscoped(store):
+    store.wait(["phase/flag"])
+
+
+def writes_both_scoped(store, gen):
+    # NEGATIVE: both sides carry the gen scope
+    store.set(f"epoch/flag/gen{gen}", b"1")
+
+
+def waits_both_scoped(store, gen):
+    store.wait([f"epoch/flag/gen{gen}"])
+
+
+def gc_phase(store, gen):
+    store.delete_key(f"phase/flag/gen{gen}")
+    store.delete_key(f"epoch/flag/gen{gen}")

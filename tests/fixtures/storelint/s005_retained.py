@@ -1,0 +1,24 @@
+"""S005 fixture: an unbounded (holed) key family with producers but no
+delete/GC path anywhere."""
+
+
+def publish(store, seq):
+    # POSITIVE: log/item{seq} grows forever, nothing ever deletes it
+    store.set(f"log/item{seq}", b"x")
+
+
+def read(store, seq):
+    return store.get(f"log/item{seq}")
+
+
+def publish_collected(store, seq):
+    # NEGATIVE: same shape, but gc() below reclaims the family
+    store.set(f"tmp/item{seq}", b"x")
+
+
+def read_collected(store, seq):
+    return store.get(f"tmp/item{seq}")
+
+
+def gc(store, seq):
+    store.delete_key(f"tmp/item{seq}")

@@ -1,0 +1,21 @@
+"""S006 fixture: a compare_set claim with no rescan loop — the loser
+of the race never retries and silently does nothing."""
+
+
+def claim_once(store, seq):
+    # POSITIVE: one-shot CAS; a lost race is never retried
+    return store.compare_set(f"claim/seq{seq}", b"", b"me")
+
+
+def claim_with_rescan(store):
+    # NEGATIVE: the claim lives inside a rescan loop over the family
+    seq = 0
+    while seq < 8:
+        if store.get(f"lease/seq{seq}") == b"":
+            store.compare_set(f"lease/seq{seq}", b"", b"me")
+        seq += 1
+
+
+def gc_claims(store, seq):
+    store.delete_key(f"claim/seq{seq}")
+    store.delete_key(f"lease/seq{seq}")

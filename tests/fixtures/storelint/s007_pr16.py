@@ -1,0 +1,42 @@
+"""S007 fixture: the PR 16 ledger-race bug class — the head counter is
+bumped BEFORE the payload it covers is written, so a scanning consumer
+can observe the counter with nothing behind it."""
+
+
+def submit_reverted(store, item):
+    # POSITIVE: head first, payload second (the exact PR 16 revert)
+    seq = 7
+    store.add("ledger/head", 1)
+    store.set(f"ledger/item{seq}", item)
+
+
+def submit_fixed(store, item):
+    # NEGATIVE: payload lands before the counter announces it
+    seq = 7
+    store.set(f"okledger/item{seq}", item)
+    store.add("okledger/head", 1)
+
+
+def submit_allocator(store, item):
+    # NEGATIVE: allocator idiom — the add RESULT names the payload
+    # slot, so the counter necessarily precedes it
+    seq = store.add("alloc/head", 1)
+    store.set(f"alloc/item{seq}", item)
+
+
+def consume(store, seq):
+    head = store.add("ledger/head", 0)
+    ok_head = store.add("okledger/head", 0)
+    alloc_head = store.add("alloc/head", 0)
+    vals = (
+        store.get(f"ledger/item{seq}"),
+        store.get(f"okledger/item{seq}"),
+        store.get(f"alloc/item{seq}"),
+    )
+    return head, ok_head, alloc_head, vals
+
+
+def gc_ledgers(store, seq):
+    store.delete_key(f"ledger/item{seq}")
+    store.delete_key(f"okledger/item{seq}")
+    store.delete_key(f"alloc/item{seq}")

@@ -441,6 +441,20 @@ class TestResizeKeyHardening:
         agent._store = HashStore(timeout=1.0)  # duck-typed store surface
         return agent, agent._store
 
+    @staticmethod
+    def _consumed(store):
+        """Retired = absent OR the CAS tombstone (b"") — consume is a
+        guarded compare_set, not a delete, so a NEWER stamp published
+        mid-teardown can never be destroyed with the old one."""
+        from pytorch_distributed_example_tpu.elastic.agent import (
+            _RESIZE_KEY,
+        )
+
+        return (
+            not store.check([_RESIZE_KEY])
+            or store.get(_RESIZE_KEY) == b""
+        )
+
     def test_stamped_request_parses_and_clamps(self):
         from pytorch_distributed_example_tpu.elastic.agent import (
             _RESIZE_KEY,
@@ -474,7 +488,7 @@ class TestResizeKeyHardening:
         # across a generation bump) is consumed as a no-op
         store.set(_RESIZE_KEY, raw)
         assert agent._resize_target() is None
-        assert not store.check([_RESIZE_KEY])
+        assert self._consumed(store)
         # ...even for an agent that restarted in between (the high-water
         # is persisted in the store, not agent memory)
         agent2, _ = self._agent(nproc=3)
@@ -510,7 +524,7 @@ class TestResizeKeyHardening:
         for garbage in (b"\xff\xfe", b"junk", b"2@x", b"@@", b""):
             store.set(_RESIZE_KEY, garbage)
             assert agent._resize_target() is None
-            assert not store.check([_RESIZE_KEY])  # consumed, no spin
+            assert self._consumed(store)  # no spin on the garbage
 
     def test_newer_target_survives_consume_of_older(self):
         from pytorch_distributed_example_tpu.elastic.agent import (
@@ -535,7 +549,7 @@ class TestResizeKeyHardening:
         agent, store = self._agent(nproc=3)
         seq = _stamp_resize(store, 3)  # already the active size
         assert agent._resize_target() is None
-        assert not store.check([_RESIZE_KEY])
+        assert self._consumed(store)
         assert agent._resize_done_seq(store) == seq
 
 

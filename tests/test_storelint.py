@@ -1,0 +1,239 @@
+"""storelint unit tests (ISSUE 17): rule corpus, real-repo registry,
+and the interleaving explorer.
+
+The static half is pinned against `tests/fixtures/storelint/` — one
+module per rule with a positive site (must fire) and a negative site
+(the corrected protocol, must stay clean). The explorer half is pinned
+on hand-built scenarios (a two-actor check-then-set claim race the
+explorer MUST catch; its compare_set correction it must prove clean by
+exhaustion) plus the shipped protocol scenarios and the seeded PR 16
+revert, which must reproduce the ledger race as a counterexample
+schedule."""
+
+import os
+
+import pytest
+
+from pytorch_distributed_example_tpu.tools import storelint as sl
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "storelint")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    cfg = sl.StorelintConfig(paths=["."], exclude=[])
+    findings, reg = sl.lint(FIXTURES, cfg)
+    return findings, reg
+
+
+def _active(findings, rule=None):
+    return [
+        f
+        for f in findings
+        if not f.suppressed and (rule is None or f.rule == rule)
+    ]
+
+
+class TestRulesOnFixtures:
+    """Each rule fires exactly once, on the positive site only."""
+
+    def test_exactly_one_active_finding_per_rule(self, fixture_findings):
+        findings, _ = fixture_findings
+        by_rule = sorted(f.rule for f in _active(findings))
+        assert by_rule == sorted(sl.RULES)  # one of each, nothing more
+
+    def test_s001_hang_at_wait(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S001")
+        assert f.path == "s001_wait.py"
+        assert "job/phantom/ready" in f.message
+        assert not any("job/real" in g.message for g in _active(findings))
+
+    def test_s002_dead_write(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S002")
+        assert f.path == "s002_dead_write.py"
+        assert "audit/blob" in f.message
+        assert not any("audit/live" in g.message for g in _active(findings))
+
+    def test_s003_format_skew_names_both_sides(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S003")
+        assert f.path == "s003_skew.py"
+        assert "result/node{rank}" in f.message
+        assert "result/rank{rank}" in f.message
+        assert not any("stats/" in g.message for g in _active(findings))
+
+    def test_s004_scope_mismatch(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S004")
+        assert f.path == "s004_scope.py"
+        assert "phase/flag" in f.message
+        assert not any("epoch/" in g.message for g in _active(findings))
+
+    def test_s005_retained_family(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S005")
+        assert f.path == "s005_retained.py"
+        assert "log/item{seq}" in f.message
+        assert not any("tmp/item" in g.message for g in _active(findings))
+
+    def test_s006_one_shot_cas(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S006")
+        assert f.path == "s006_cas.py"
+        assert "claim/seq{seq}" in f.message
+        assert not any("lease/seq" in g.message for g in _active(findings))
+
+    def test_s007_counter_before_payload(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "S007")
+        assert f.path == "s007_pr16.py"
+        assert "ledger/head" in f.message
+        # the fixed ordering and the allocator idiom both stay clean
+        assert not any("okledger" in g.message for g in _active(findings))
+        assert not any("alloc/" in g.message for g in _active(findings))
+
+    def test_inline_suppression_with_reason(self, fixture_findings):
+        findings, _ = fixture_findings
+        supp = [f for f in findings if f.suppressed]
+        assert [f.path for f in supp] == ["s00x_suppressed.py"]
+        assert supp[0].rule == "S001"
+
+    def test_fingerprints_are_stable_and_unique(self, fixture_findings):
+        findings, _ = fixture_findings
+        prints = [f.fingerprint for f in findings]
+        assert all(prints)
+        assert len(set(prints)) == len(prints)
+
+
+class TestConfig:
+    def test_severity_off_silences_a_rule(self):
+        cfg = sl.StorelintConfig(
+            paths=["."], exclude=[], severity={"S005": "off"}
+        )
+        findings, _ = sl.lint(FIXTURES, cfg)
+        assert not _active(findings, "S005")
+        assert _active(findings, "S001")  # others unaffected
+
+    def test_severity_warning_downgrades(self):
+        cfg = sl.StorelintConfig(
+            paths=["."], exclude=[], severity={"S006": "warning"}
+        )
+        findings, _ = sl.lint(FIXTURES, cfg)
+        (f,) = _active(findings, "S006")
+        assert f.severity == "warning"
+
+    def test_repo_pyproject_section_loads(self):
+        cfg = sl.load_config(REPO_ROOT)
+        assert "pytorch_distributed_example_tpu" in cfg.paths
+        assert any("storelint.py" in e for e in cfg.exclude)
+
+
+class TestRealRepoRegistry:
+    """The harvester sees the shipped protocols: the producer/consumer
+    registry over the real tree names the families the explorer
+    re-enacts, with both sides present."""
+
+    @pytest.fixture(scope="class")
+    def reg(self):
+        cfg = sl.load_config(REPO_ROOT)
+        reg, _ = sl.collect_registry(REPO_ROOT, cfg)
+        return reg
+
+    def test_ledger_family_has_both_sides(self, reg):
+        assert reg.select(op="write", pattern="serve/work/item/*")
+        assert reg.select(op="read", pattern="serve/work/item/*")
+        assert reg.select(op="delete", pattern="serve/work/item/*")
+
+    def test_claim_family_is_cas(self, reg):
+        assert reg.select(op="cas", pattern="serve/work/claim/*")
+
+    def test_registration_rows_are_gen_scoped(self, reg):
+        rows = reg.select(pattern="serve/worker/*")
+        assert rows
+        assert all(u.scoped for u in rows)
+
+    def test_resize_stamp_is_cas_consumed(self, reg):
+        # the PR-17 TOCTOU fix: the stamp is retired by guarded CAS,
+        # not an unguarded delete
+        assert reg.select(op="cas", pattern="agent/resize_target")
+
+
+class TestExplorer:
+    """The dynamic half: a hand-built race it must catch, the
+    corrected protocol it must prove clean, and the shipped scenarios
+    with the seeded PR 16 revert."""
+
+    @staticmethod
+    def _check_then_set(fixed: bool) -> sl.Scenario:
+        winners = []
+
+        def actor(name):
+            def body(store, clock):
+                if fixed:
+                    got = store.compare_set("race/claim", b"", name)
+                    if got == name:
+                        winners.append(name)
+                else:
+                    if not store.check(["race/claim"]):
+                        store.set("race/claim", name)
+                        winners.append(name)
+
+            return body
+
+        def invariants(store):
+            if len(winners) > 1:
+                return [f"double claim: {winners}"]
+            return []
+
+        return sl.Scenario(
+            name="claim-race",
+            actors=[("a", actor(b"a")), ("b", actor(b"b"))],
+            invariants=invariants,
+        )
+
+    def test_check_then_set_race_is_caught(self):
+        report = sl.explore(
+            lambda: self._check_then_set(fixed=False), max_schedules=200
+        )
+        assert not report.ok
+        assert report.counterexample is not None
+        assert "double claim" in report.counterexample.violations[0]
+        trace = sl.render_trace(report.counterexample, ["a", "b"])
+        assert "check" in trace and "set race/claim" in trace
+
+    def test_cas_claim_is_proved_clean_by_exhaustion(self):
+        report = sl.explore(
+            lambda: self._check_then_set(fixed=True), max_schedules=200
+        )
+        assert report.ok
+        assert report.exhausted  # the full schedule space, not a sample
+
+    def test_seeded_pr16_revert_is_caught(self):
+        report = sl.explore(
+            lambda: sl._scenario_ledger(revert_pr16=True),
+            max_schedules=600,
+        )
+        assert not report.ok
+        assert any(
+            "LOST" in v for v in report.counterexample.violations
+        )
+
+    def test_shipped_ledger_passes_quick_sweep(self):
+        report = sl.explore(sl.SCENARIOS["ledger"], max_schedules=150)
+        assert report.ok
+
+    def test_done_scenario_exhausts(self):
+        report = sl.explore(sl.SCENARIOS["done"], max_schedules=150)
+        assert report.ok
+        assert report.exhausted
+
+    def test_run_scenarios_appends_revert_run(self):
+        reports = sl.run_scenarios(
+            names=["done"], seed_revert="pr16", max_schedules=150
+        )
+        assert len(reports) == 2
+        assert reports[0].ok
+        assert not reports[1].ok  # the revert run must fail

@@ -1,0 +1,133 @@
+"""storelint self-gate: the coordination-plane analyzer over the
+repo's OWN store protocols — the tier-1 contract mirroring
+`tests/test_distlint_self.py` / `test_proglint_self.py`:
+
+  * zero unsuppressed error findings over the real tree (every
+    suppression carries a reason; the triage is done, the ratchet
+    holds);
+  * the committed `.storelint-baseline.json` is EMPTY — the ratchet
+    starts and stays at zero entries (the naive first-run count is
+    recorded for history only);
+  * the exact ISSUE CLI (`--format sarif --baseline
+    .storelint-baseline.json`) exits 0 as a subprocess with
+    structurally-valid SARIF 2.1.0 carrying storelint/v1
+    partialFingerprints;
+  * the quick interleaving sweep (`--explore --quick --seed-revert
+    pr16`) exits 0: every shipped protocol scenario passes AND the
+    seeded PR 16 revert is caught as a counterexample schedule.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_example_tpu.tools import storelint as sl
+
+from tests._mp_util import REPO
+
+BASELINE = os.path.join(REPO, ".storelint-baseline.json")
+
+
+class TestRepoTreeClean:
+    def test_zero_unsuppressed_findings(self):
+        findings, _ = sl.lint(REPO, sl.load_config(REPO))
+        active = [
+            f
+            for f in findings
+            if not f.suppressed and f.severity == "error"
+        ]
+        assert not active, "\n".join(
+            f"{f.path}:{f.line} {f.rule} {f.message}" for f in active
+        )
+
+    def test_baseline_is_committed_and_empty(self):
+        with open(BASELINE, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert doc["tool"] == "storelint"
+        assert doc["findings"] == [], (
+            "the storelint ratchet starts (and must stay) at zero — "
+            "fix or suppress findings instead of baselining them"
+        )
+        # history: the naive pre-triage run surfaced real work
+        assert doc["naive_first_run_count"] >= 1
+
+
+class TestSarifCliGate:
+    """The exact ISSUE CLI as a subprocess: exit 0, valid SARIF."""
+
+    @pytest.fixture(scope="class")
+    def cli(self):
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.storelint",
+                "--format",
+                "sarif",
+                "--baseline",
+                ".storelint-baseline.json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=600,
+        )
+
+    def test_exit_zero(self, cli):
+        assert cli.returncode == 0, cli.stdout + cli.stderr
+
+    def test_sarif_shape(self, cli):
+        doc = json.loads(cli.stdout)
+        assert doc["version"] == "2.1.0"
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "storelint"
+        rules = {r["id"] for r in driver["rules"]}
+        assert {f"S{i:03d}" for i in range(1, 8)} <= rules
+        for r in doc["runs"][0]["results"]:
+            assert r["partialFingerprints"]["storelint/v1"]
+        assert not [
+            r
+            for r in doc["runs"][0]["results"]
+            if r.get("baselineState") == "new"
+        ]
+
+
+class TestExploreCliGate:
+    """`--explore --quick --seed-revert pr16` IS the tier-1 dynamic
+    gate: shipped protocols pass, the seeded revert must be caught."""
+
+    @pytest.fixture(scope="class")
+    def cli(self):
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.storelint",
+                "--explore",
+                "--quick",
+                "--seed-revert",
+                "pr16",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=600,
+        )
+
+    def test_exit_zero(self, cli):
+        assert cli.returncode == 0, cli.stdout + cli.stderr
+
+    def test_shipped_scenarios_pass(self, cli):
+        for name in sl.SCENARIOS:
+            assert (
+                f"scenario '{name}': no violation" in cli.stdout
+            ), cli.stdout
+
+    def test_revert_prints_a_counterexample(self, cli):
+        out = cli.stdout
+        assert "revert" in out and "counterexample" in out, out
+        # the per-actor trace names the racing ops
+        assert "add serve/work/head" in out, out

@@ -741,3 +741,85 @@ class TestRedistributeForServing:
         # replicated-load reference: host values into the same engine
         ref = run(jax.device_get(params))
         assert got == ref
+
+
+class TestUpdateCouplingClassifier:
+    """Chain-structural elementwise-ness detection (the ROADMAP carried
+    follow-on to ISSUE 10): `classify_update_coupling` walks the optax
+    chain's closures for factory names whose transforms couple elements
+    across a leaf, and `make_ddp_train_step` warns at BUILD time when
+    the sharded update would silently change their math. The shape-
+    structural detector cannot see these — a trust-ratio or global-norm
+    clip keeps param-shaped (or empty) state."""
+
+    def _classify(self, opt):
+        from pytorch_distributed_example_tpu.parallel.ddp import (
+            classify_update_coupling,
+        )
+
+        return classify_update_coupling(opt)
+
+    def test_elementwise_chains_stay_clean(self):
+        import optax
+
+        for opt in (
+            optax.adam(1e-3),
+            optax.adamw(1e-3),
+            optax.sgd(1e-2, momentum=0.9),
+        ):
+            assert self._classify(opt) == ("elementwise", [])
+
+    def test_adafactor_is_factored(self):
+        import optax
+
+        kind, hits = self._classify(optax.adafactor(1e-3))
+        assert kind == "factored"
+        assert "scale_by_factored_rms" in hits
+
+    def test_lamb_trust_ratio_is_per_leaf_norm(self):
+        import optax
+
+        kind, hits = self._classify(optax.lamb(1e-3))
+        assert kind == "per_leaf_norm"
+        assert hits == ["scale_by_trust_ratio"]
+
+    def test_global_norm_clip_in_a_chain(self):
+        import optax
+
+        kind, hits = self._classify(
+            optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+        )
+        assert kind == "global_norm"
+        assert hits == ["clip_by_global_norm"]
+
+    def test_non_optax_is_unknown(self):
+        assert self._classify(object()) == ("unknown", [])
+
+    def test_build_time_warning_fires_and_stays_quiet(self, world):
+        """Building a sharded step over a norm-coupled chain warns once
+        at construction (naming the offending factory); the same build
+        over adam stays silent."""
+        import warnings
+
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_example_tpu.parallel.ddp import (
+            make_ddp_train_step,
+        )
+
+        def apply_fn(p, x):
+            return x @ p["w"]
+
+        def loss_fn(logits, y):
+            return jnp.mean((logits - y) ** 2)
+
+        coupled = optax.chain(
+            optax.clip_by_global_norm(1.0), optax.adam(1e-3)
+        )
+        with pytest.warns(RuntimeWarning, match="clip_by_global_norm"):
+            make_ddp_train_step(apply_fn, loss_fn, coupled)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            make_ddp_train_step(apply_fn, loss_fn, optax.adam(1e-3))
