@@ -1004,6 +1004,13 @@ def main():
         device_kind = getattr(devs[0], "device_kind", platform)
         is_tpu = "tpu" in device_kind.lower() or platform in ("tpu", "axon")
 
+        # explicit numerics pins (benchmarks/common.py): hardware-rate
+        # matmuls, partition-invariant PRNG — results stay comparable
+        # across jax versions and world sizes
+        from benchmarks.common import pin_numerics
+
+        pin_numerics()
+
         phase = "init_process_group"
         import pytorch_distributed_example_tpu as tdx
 

@@ -30,6 +30,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CPU_PIN = (
     "import os, sys, runpy, jax\n"
     "jax.config.update('jax_platforms', 'cpu')\n"
+    # explicit numerics pins, mirroring benchmarks/common.pin_numerics
+    # (which bench.py calls itself): hardware-rate matmuls stated
+    # outright, partition-invariant PRNG matching the test suite
+    "jax.config.update('jax_default_matmul_precision', 'default')\n"
+    "jax.config.update('jax_threefry_partitionable', False)\n"
     "n = os.environ.get('TDX_CPU_DEVICES', '8')\n"
     "try:\n"
     "    jax.config.update('jax_num_cpu_devices', int(n))\n"

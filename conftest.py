@@ -24,6 +24,29 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Determinism pins (ISSUE 18; numlint N001 cites these — the sweep and
+# the bitwise parity tests assume them):
+#  * jax_default_matmul_precision="highest" — without it, matmul
+#    accumulation dtype floats with the backend (bf16 passes on TPU),
+#    so a "bitwise" assertion can pass on CPU and silently stop
+#    meaning anything on hardware. Library code on bitwise-contract
+#    paths must ALSO pin per call (numlint N001 enforces that); this
+#    repo-wide pin covers the test harness itself.
+#  * jax_threefry_partitionable=False — pinned to the LEGACY value,
+#    explicitly. Upstream is flipping this default (partition-invariant
+#    PRNG lowering), and flipping it changes every threefry stream:
+#    measured here, it perturbs random-init logits enough to flip
+#    argmax on near-tied tokens and expose 1-ULP scan-vs-sequential
+#    reassociation differences, failing five token-exact/bitwise
+#    parity tests whose reference behavior was established under the
+#    legacy stream. The pin makes that flip a DELIBERATE one-PR event
+#    (re-baseline the affected parity tests when taking it) instead of
+#    a silent side effect of a jax upgrade. The numlint sweep
+#    subprocess pins the same value so sweep hashes and suite hashes
+#    come from the same stream family.
+jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_threefry_partitionable", False)
+
 # Persistent compilation cache: the suite is compile-dominated (hundreds
 # of distinct jit programs over the 8-device mesh); caching compiled
 # executables across runs turns repeat runs from ~5 min into the actual

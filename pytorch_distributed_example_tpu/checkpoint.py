@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import faults
+from .numerics import numerics_contract
 from .types import DistError
 
 MANIFEST = "manifest.json"
@@ -257,6 +258,12 @@ def last_good_path(path: str) -> str:
 # ---------------------------------------------------------------------------
 
 
+@numerics_contract(
+    "bitwise",
+    note="save/load round-trips the live param tree bit-exactly: leaf "
+    "dtypes are recorded in the manifest and restored on load, never "
+    "silently re-cast",
+)
 def save_checkpoint(
     path: str,
     params: Any,
@@ -393,6 +400,11 @@ def _load_verified(
     return params, opt_state, meta["step"], meta.get("extra", {})
 
 
+@numerics_contract(
+    "bitwise",
+    note="inverse of save_checkpoint: leaves come back in their "
+    "manifest-recorded dtypes, byte-for-byte",
+)
 def load_checkpoint(
     path: str,
     template_params: Any,

@@ -40,6 +40,8 @@ from __future__ import annotations
 import math
 from typing import Optional, Tuple
 
+from ..numerics import numerics_contract
+
 DEFAULT_BLOCK_SIZE = 256
 _FP8_MAX = 448.0  # float8_e4m3fn largest finite
 WIRE_FORMATS = ("int8", "fp8")
@@ -49,6 +51,11 @@ def _qmax(bits: int) -> int:
     return 2 ** (bits - 1) - 1
 
 
+@numerics_contract(
+    "tolerance",
+    note="symmetric int8 round-trip: |dq - x| <= blockwise amax / qmax "
+    "per element (data-dependent envelope; see tests/test_quant.py)",
+)
 def quantize_blockwise(
     x, block_size: int = DEFAULT_BLOCK_SIZE, bits: int = 8
 ):
@@ -162,6 +169,13 @@ def allreduce_wire_bytes(
     return int(2 * (world - 1) / world * n * (per_elem + scale))
 
 
+@numerics_contract(
+    "tolerance",
+    rtol=5e-2,
+    atol=5e-3,
+    note="wire-quantized mean vs exact mean (PR 7, EQuARX-style "
+    "envelope; tests/test_quant.py verifies at exactly this rtol/atol)",
+)
 def quantized_all_reduce(
     x,
     axis_name,
@@ -262,6 +276,12 @@ def quantized_all_reduce(
 # ---------------------------------------------------------------------------
 
 
+@numerics_contract(
+    "tolerance",
+    note="per-(token, kv-head) int8 KV round-trip: |dq - x| <= vector "
+    "amax / qmax (PR 11; token-match-rate claims live on the serve "
+    "plane, see benchmarks/serve_bench.py)",
+)
 def quantize_kv(x, bits: int = 8):
     """Quantize K/V vectors for the paged cache: x (..., Dh) ->
     (q int8 (..., Dh), scales f32 (...,)) with ONE max-abs scale per

@@ -39,6 +39,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
+from ..numerics import numerics_contract
 from ..tensor import DistTensor
 from ..types import ReduceOp
 from . import comm_hooks, zero
@@ -325,6 +326,11 @@ def classify_update_coupling(optimizer) -> Tuple[str, list]:
     return "elementwise", []
 
 
+@numerics_contract(
+    "bitwise",
+    note="ZeRO sharded weight update is bit-identical to the unsharded "
+    "update for elementwise optimizers (PR 10, tests/test_zero_update.py)",
+)
 def make_ddp_train_step(
     apply_fn: Callable,
     loss_fn: Callable,

@@ -107,6 +107,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import faults
+from ..numerics import numerics_contract
 from ..types import DistError
 from .bucketing import bucket_for, bucket_lengths
 from .cache import PagedKVCache
@@ -763,6 +764,12 @@ class ServeEngine:
             self.metrics.record_requeue()
 
     # -- decode ------------------------------------------------------------
+    @numerics_contract(
+        "token_exact",
+        note="a greedy request's emitted token stream is identical "
+        "across resizes, restores, and cache-sharing on/off (PR 16; "
+        "per-request seeds + fold_in discipline make replay exact)",
+    )
     def step(self) -> bool:
         """One engine iteration: admit, advance prefills (one chunk when
         chunking is on), grow/preempt blocks, advance every decoding

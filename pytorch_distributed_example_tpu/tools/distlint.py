@@ -208,6 +208,20 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from ._lintcore import (  # noqa: F401  (re-exported; see module docstring)
+    SEVERITIES,
+    Finding,
+    apply_baseline,
+    baseline_entries,
+    load_baseline,
+    load_pyproject_section,
+    parse_severity_table,
+    parse_suppressions,
+    render_report,
+    write_baseline,
+)
+from ._lintcore import render_sarif as _render_sarif_core
+
 __all__ = [
     "Finding",
     "LintConfig",
@@ -242,7 +256,9 @@ RULES = {
     "R015": "PartitionSpec axis name not declared by any mesh project-wide",
 }
 
-SEVERITIES = ("error", "warning", "off")
+# SEVERITIES / Finding / baseline ratchet / renderers live in
+# tools/_lintcore.py (shared across distlint, proglint, storelint,
+# numlint) and are re-exported here unchanged.
 
 # Collective entry points (the schedule-divergence surface). p2p ops
 # (send/recv/isend/irecv) are deliberately absent: they are rank-directed
@@ -378,50 +394,7 @@ DEFAULT_FAULT_REGISTRY = "pytorch_distributed_example_tpu/faults.py"
 # GC is irrelevant, so they are out of scope by default.
 DEFAULT_STORE_LIFECYCLE_PATHS = ["pytorch_distributed_example_tpu", "examples"]
 
-_SUPPRESS_RE = re.compile(r"#\s*distlint:\s*disable=([A-Za-z0-9_,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(r"#\s*distlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
 _POINT_IN_STRING_RE = re.compile(r'"point"\s*:\s*"([^"]*)"')
-
-
-@dataclass
-class Finding:
-    path: str
-    line: int
-    col: int
-    rule: str
-    message: str
-    suppressed: bool = False
-    severity: str = "error"
-    baselined: bool = False
-    fingerprint: str = ""
-    trace: Tuple[str, ...] = ()
-
-    def to_dict(self) -> Dict:
-        d = {
-            "path": self.path,
-            "line": self.line,
-            "col": self.col,
-            "rule": self.rule,
-            "message": self.message,
-            "suppressed": self.suppressed,
-            "severity": self.severity,
-            "baselined": self.baselined,
-            "fingerprint": self.fingerprint,
-        }
-        if self.trace:
-            d["trace"] = list(self.trace)
-        return d
-
-    def render(self) -> str:
-        tags = []
-        if self.severity != "error":
-            tags.append(self.severity)
-        if self.baselined:
-            tags.append("baselined")
-        if self.suppressed:
-            tags.append("suppressed")
-        tag = f" ({', '.join(tags)})" if tags else ""
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
 
 
 @dataclass
@@ -449,19 +422,7 @@ def load_config(root: str) -> LintConfig:
     """Read ``[tool.distlint]`` from ``<root>/pyproject.toml`` (missing
     file/section/parser → defaults)."""
     cfg = LintConfig()
-    pp = os.path.join(root, "pyproject.toml")
-    if not os.path.isfile(pp):
-        return cfg
-    try:
-        try:
-            import tomllib  # py311+
-        except ImportError:
-            import tomli as tomllib  # py310 vendored parser
-        with open(pp, "rb") as f:
-            doc = tomllib.load(f)
-    except Exception as e:
-        raise ValueError(f"could not parse {pp}: {e}") from e
-    section = doc.get("tool", {}).get("distlint", {})
+    section = load_pyproject_section(root, "distlint")
     if "paths" in section:
         cfg.paths = [str(p) for p in section["paths"]]
     if "exclude" in section:
@@ -476,13 +437,7 @@ def load_config(root: str) -> LintConfig:
         cfg.trace_roots = [str(p) for p in section["trace_roots"]]
     if "known_mesh_axes" in section:
         cfg.known_mesh_axes = [str(p) for p in section["known_mesh_axes"]]
-    for rule, sev in dict(section.get("severity", {})).items():
-        sev = str(sev).lower()
-        if sev not in SEVERITIES:
-            raise ValueError(
-                f"[tool.distlint.severity] {rule} = {sev!r}: must be one of {SEVERITIES}"
-            )
-        cfg.severity[str(rule).upper()] = sev
+    cfg.severity = parse_severity_table(section, "distlint")
     return cfg
 
 
@@ -494,35 +449,9 @@ def load_config(root: str) -> LintConfig:
 def _parse_suppressions(
     src: str,
 ) -> Tuple[Dict[int, Set[str]], Dict[str, int]]:
-    """(line -> suppressed rules, file-wide rule -> declaring line).
-
-    Only genuine COMMENT tokens count: a suppression-shaped string inside
-    a docstring or test fixture neither suppresses nor goes stale."""
-    per_line: Dict[int, Set[str]] = {}
-    file_wide: Dict[str, int] = {}
-
-    def absorb(text: str, lineno: int) -> None:
-        m = _SUPPRESS_RE.search(text)
-        if m:
-            rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
-            per_line.setdefault(lineno, set()).update(rules)
-        m = _SUPPRESS_FILE_RE.search(text)
-        if m:
-            for r in m.group(1).split(","):
-                r = r.strip().upper()
-                if r:
-                    file_wide.setdefault(r, lineno)
-
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
-            if tok.type == tokenize.COMMENT:
-                absorb(tok.string, tok.start[0])
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        # unparsable tail (rare): fall back to the raw line scan
-        for i, line in enumerate(src.splitlines(), start=1):
-            if "#" in line:
-                absorb(line, i)
-    return per_line, file_wide
+    """(line -> suppressed rules, file-wide rule -> declaring line);
+    comment tokens only — see `_lintcore.parse_suppressions`."""
+    return parse_suppressions(src, "distlint")
 
 
 def _call_name(call: ast.Call) -> Optional[str]:
@@ -3427,153 +3356,12 @@ def harvested_mesh_axes(
 
 
 # ---------------------------------------------------------------------------
-# baseline & ratchet
+# baseline & reporting — shared toolchain in tools/_lintcore.py
 # ---------------------------------------------------------------------------
-
-
-def baseline_entries(findings: List[Finding]) -> List[Dict]:
-    """The baseline records unsuppressed error-severity findings."""
-    return [
-        {
-            "path": f.path,
-            "rule": f.rule,
-            "fingerprint": f.fingerprint,
-            "message": f.message,
-        }
-        for f in findings
-        if not f.suppressed and f.severity == "error"
-    ]
-
-
-def load_baseline(path: str) -> Dict:
-    with open(path, "r", encoding="utf-8") as fh:
-        doc = json.load(fh)
-    if not isinstance(doc, dict) or "findings" not in doc:
-        raise ValueError(f"{path}: not a distlint baseline (no 'findings' key)")
-    return doc
-
-
-def apply_baseline(
-    findings: List[Finding], baseline: Dict
-) -> Tuple[List[Finding], List[Finding], List[Dict]]:
-    """Mark baselined findings; returns (new, baselined, stale_entries).
-
-    Matching is by (path, rule, fingerprint); each baseline entry absorbs
-    at most one finding."""
-    pool: Dict[Tuple[str, str, str], List[Dict]] = {}
-    for e in baseline.get("findings", []):
-        pool.setdefault((e["path"], e["rule"], e["fingerprint"]), []).append(e)
-    new: List[Finding] = []
-    matched: List[Finding] = []
-    for f in findings:
-        if f.suppressed or f.severity != "error":
-            continue
-        key = (f.path, f.rule, f.fingerprint)
-        entries = pool.get(key)
-        if entries:
-            entries.pop()
-            if not entries:
-                del pool[key]
-            f.baselined = True
-            matched.append(f)
-        else:
-            new.append(f)
-    stale = [e for entries in pool.values() for e in entries]
-    return new, matched, stale
-
-
-def write_baseline(
-    path: str,
-    findings: List[Finding],
-    naive_count: Optional[int] = None,
-    allow_growth: bool = False,
-    tool: str = "distlint",
-) -> int:
-    """Write the ratchet file. Refuses to admit any entry that was not
-    already grandfathered (identity by path+rule+fingerprint, NOT by
-    count — fixing one finding must never buy a slot for a new one)
-    unless ``allow_growth``."""
-    entries = baseline_entries(findings)
-    prev_naive = None
-    if os.path.isfile(path):
-        try:
-            prev = load_baseline(path)
-        except (OSError, ValueError):
-            prev = {"findings": []}
-        prev_naive = prev.get("naive_first_run_count")
-        prev_keys = {
-            (e["path"], e["rule"], e["fingerprint"])
-            for e in prev.get("findings", [])
-        }
-        added = [
-            e
-            for e in entries
-            if (e["path"], e["rule"], e["fingerprint"]) not in prev_keys
-        ]
-        if added and not allow_growth:
-            raise ValueError(
-                f"ratchet violation: {len(added)} finding(s) not in the "
-                "existing baseline would be grandfathered "
-                f"(first: {added[0]['path']} {added[0]['rule']} "
-                f"{added[0]['message'][:60]}...); fix or suppress them "
-                "instead (--force-baseline-growth to override)"
-            )
-    doc = {
-        "version": 1,
-        "tool": tool,
-        "naive_first_run_count": (
-            naive_count if naive_count is not None
-            else (prev_naive if prev_naive is not None else len(entries))
-        ),
-        "findings": entries,
-    }
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=1, sort_keys=True)
-        fh.write("\n")
-    return len(entries)
-
-
-# ---------------------------------------------------------------------------
-# reporting
-# ---------------------------------------------------------------------------
-
-
-def render_report(
-    findings: List[Finding],
-    show_suppressed: bool = False,
-    show_baselined: bool = False,
-    tool: str = "distlint",
-) -> str:
-    lines: List[str] = []
-    active = [
-        f for f in findings
-        if not f.suppressed and not f.baselined and f.severity == "error"
-    ]
-    warnings = [
-        f for f in findings
-        if not f.suppressed and not f.baselined and f.severity == "warning"
-    ]
-    shown = [
-        f for f in findings
-        if (show_suppressed or not f.suppressed)
-        and (show_baselined or not f.baselined)
-    ]
-    for f in shown:
-        lines.append(f.render())
-    n_sup = sum(1 for f in findings if f.suppressed)
-    n_base = sum(1 for f in findings if f.baselined)
-    by_rule: Dict[str, int] = {}
-    for f in active:
-        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-    summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) or "none"
-    lines.append(
-        f"{tool}: {len(active)} finding(s) ({summary}); "
-        f"{len(warnings)} warning(s); {n_base} baselined; {n_sup} suppressed"
-    )
-    return "\n".join(lines)
-
-
-_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+# baseline_entries / load_baseline / apply_baseline / write_baseline /
+# render_report are imported (and re-exported) verbatim; render_sarif
+# keeps a thin wrapper here so a bare `render_sarif(findings)` still
+# emits the distlint driver block (RULES) by default.
 
 
 def render_sarif(
@@ -3585,78 +3373,17 @@ def render_sarif(
     information_uri: Optional[str] = None,
     fingerprint_key: str = "distlint/v1",
 ) -> Dict:
-    """SARIF 2.1.0 document. When a baseline was applied, baselined
-    findings carry baselineState=unchanged and the rest baselineState=new.
-    Pass ``baseline_mode`` explicitly when an EMPTY baseline was applied —
-    auto-detection (any f.baselined) cannot see the difference between
-    "no baseline" and "baseline that matched nothing", and a consumer
-    filtering on baselineState=='new' must not lose findings then.
-
-    ``tool_name``/``rules``/``information_uri``/``fingerprint_key`` let a
-    sibling analyzer (tools/proglint.py) emit its own driver block
-    through this one renderer instead of forking the SARIF layout."""
-    if baseline_mode is None:
-        baseline_mode = any(f.baselined for f in findings)
-    results = []
-    for f in findings:
-        if f.rule == "E000":
-            level = "error"
-        else:
-            level = _SARIF_LEVEL.get(f.severity, "note")
-        if f.suppressed and not show_suppressed:
-            continue
-        res = {
-            "ruleId": f.rule,
-            "level": level,
-            "message": {"text": f.message},
-            "locations": [
-                {
-                    "physicalLocation": {
-                        "artifactLocation": {"uri": f.path},
-                        "region": {"startLine": max(f.line, 1), "startColumn": max(f.col, 1)},
-                    }
-                }
-            ],
-            "partialFingerprints": {fingerprint_key: f.fingerprint},
-        }
-        if f.trace:
-            res["message"]["text"] += "  [chain: " + " -> ".join(f.trace) + "]"
-        if f.suppressed:
-            res["suppressions"] = [{"kind": "inSource"}]
-        # only error-severity findings live in the ratchet: a warning can
-        # never be baselined (apply_baseline skips it by design), so
-        # marking it "new" forever would fail consumers gating on
-        # baselineState for findings the tool itself deems non-failing
-        if baseline_mode and not f.suppressed and f.severity == "error":
-            res["baselineState"] = "unchanged" if f.baselined else "new"
-        results.append(res)
-    return {
-        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
-        "version": "2.1.0",
-        "runs": [
-            {
-                "tool": {
-                    "driver": {
-                        "name": tool_name,
-                        "informationUri": (
-                            information_uri
-                            or "pytorch_distributed_example_tpu/tools/distlint.py"
-                        ),
-                        "rules": [
-                            {
-                                "id": rid,
-                                "shortDescription": {"text": desc},
-                            }
-                            for rid, desc in sorted(
-                                (rules if rules is not None else RULES).items()
-                            )
-                        ],
-                    }
-                },
-                "results": results,
-            }
-        ],
-    }
+    """SARIF 2.1.0 via `_lintcore.render_sarif`, defaulting the driver
+    block to distlint's own RULES."""
+    return _render_sarif_core(
+        findings,
+        show_suppressed=show_suppressed,
+        baseline_mode=baseline_mode,
+        tool_name=tool_name,
+        rules=RULES if rules is None else rules,
+        information_uri=information_uri,
+        fingerprint_key=fingerprint_key,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -64,16 +64,16 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import traceguard
-from .distlint import (
+from ._lintcore import (
     SEVERITIES,
     Finding,
     apply_baseline,
-    harvested_mesh_axes,
     load_baseline,
     render_report,
     render_sarif,
     write_baseline,
 )
+from .distlint import harvested_mesh_axes
 
 __all__ = [
     "RULES",

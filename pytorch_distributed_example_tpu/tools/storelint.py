@@ -94,18 +94,19 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from .distlint import (
+from ._lintcore import (
     SEVERITIES,
     Finding,
-    ModuleInfo,
-    Project,
     apply_baseline,
-    build_project,
     load_baseline,
+    load_pyproject_section,
+    parse_severity_table,
+    parse_suppressions,
     render_report,
     render_sarif,
     write_baseline,
 )
+from .distlint import ModuleInfo, Project, build_project
 from .distlint import LintConfig as _DistlintConfig
 from .distlint import _SCOPE_FIELD_RE, _store_like_receiver
 
@@ -147,11 +148,6 @@ RULES = {
 }
 
 _INFO_URI = "https://github.com/dblakely/pytorch-distributed-example"
-
-_SUPPRESS_RE = re.compile(r"#\s*storelint:\s*disable=([A-Za-z0-9_,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(
-    r"#\s*storelint:\s*disable-file=([A-Za-z0-9_,\s]+)"
-)
 
 # Store-op method names → (op kind, key argument position).
 _STORE_OPS = {
@@ -210,19 +206,7 @@ def load_config(root: str) -> StorelintConfig:
     """Read ``[tool.storelint]`` from ``<root>/pyproject.toml``
     (missing file/section → defaults)."""
     cfg = StorelintConfig()
-    pp = os.path.join(root, "pyproject.toml")
-    if not os.path.isfile(pp):
-        return cfg
-    try:
-        try:
-            import tomllib  # py311+
-        except ImportError:
-            import tomli as tomllib
-        with open(pp, "rb") as f:
-            doc = tomllib.load(f)
-    except Exception as e:
-        raise ValueError(f"could not parse {pp}: {e}") from e
-    section = doc.get("tool", {}).get("storelint", {})
+    section = load_pyproject_section(root, "storelint")
     for name in (
         "paths",
         "exclude",
@@ -233,14 +217,7 @@ def load_config(root: str) -> StorelintConfig:
     ):
         if name in section:
             setattr(cfg, name, [str(p) for p in section[name]])
-    for rule, sev in dict(section.get("severity", {})).items():
-        sev = str(sev).lower()
-        if sev not in SEVERITIES:
-            raise ValueError(
-                f"[tool.storelint.severity] {rule} = {sev!r}: "
-                f"must be one of {SEVERITIES}"
-            )
-        cfg.severity[str(rule).upper()] = sev
+    cfg.severity = parse_severity_table(section, "storelint")
     return cfg
 
 
@@ -1128,35 +1105,8 @@ def _parse_suppressions(
     src: str,
 ) -> Tuple[Dict[int, Set[str]], Dict[str, int]]:
     """(line → suppressed rules, file-wide rule → declaring line);
-    comments only, same discipline as distlint."""
-    per_line: Dict[int, Set[str]] = {}
-    file_wide: Dict[str, int] = {}
-
-    def absorb(text: str, lineno: int) -> None:
-        m = _SUPPRESS_RE.search(text)
-        if m:
-            rules = {
-                r.strip().upper()
-                for r in m.group(1).split(",")
-                if r.strip()
-            }
-            per_line.setdefault(lineno, set()).update(rules)
-        m = _SUPPRESS_FILE_RE.search(text)
-        if m:
-            for r in m.group(1).split(","):
-                r = r.strip().upper()
-                if r:
-                    file_wide.setdefault(r, lineno)
-
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
-            if tok.type == tokenize.COMMENT:
-                absorb(tok.string, tok.start[0])
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        for i, line in enumerate(src.splitlines(), start=1):
-            if "#" in line:
-                absorb(line, i)
-    return per_line, file_wide
+    comments only — see `_lintcore.parse_suppressions`."""
+    return parse_suppressions(src, "storelint")
 
 
 def _apply_suppressions(

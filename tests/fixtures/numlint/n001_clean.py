@@ -1,0 +1,20 @@
+"""N001 negative: same bitwise path and the same bfloat16 evidence,
+but the matmul pins its accumulation dtype — numlint must stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import jax.numpy as jnp
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+def cast_for_compute_ok(x):
+    return x.astype(jnp.bfloat16)
+
+
+@numerics_contract("bitwise")
+def train_step_ok(params, batch):
+    h = cast_for_compute_ok(batch)
+    # clean: preferred_element_type pins the accumulator
+    return jnp.dot(h, params, preferred_element_type=jnp.float32)

@@ -1,0 +1,20 @@
+"""N001 positive: matmul on a bitwise-contract path with no pinned
+precision, in a module that mixes precisions (bfloat16 below).
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import jax.numpy as jnp
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+def cast_for_compute(x):
+    return x.astype(jnp.bfloat16)
+
+
+@numerics_contract("bitwise")
+def train_step(params, batch):
+    h = cast_for_compute(batch)
+    # MUST FIRE N001: accumulation dtype floats with the backend
+    return jnp.dot(h, params)

@@ -1,0 +1,20 @@
+"""N002 negative: the same psum_scatter decomposition under a
+TOLERANCE contract — reduction-order reassociation is inside a
+tolerance envelope's budget, so numlint must stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+from jax import lax
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+def scatter_grads_tol(flat):
+    # clean: only bitwise contracts forbid reassociation
+    return lax.psum_scatter(flat, "dp", tiled=True)
+
+
+@numerics_contract("tolerance", rtol=1e-5, atol=1e-6)
+def approx_update(flat):
+    return scatter_grads_tol(flat)

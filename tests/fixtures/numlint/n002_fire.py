@@ -1,0 +1,20 @@
+"""N002 positive: a reduction-order decomposition (psum_scatter)
+reachable from a bitwise contract, with no parity-preserving
+whitelist entry for this file.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+from jax import lax
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+def scatter_grads(flat):
+    # MUST FIRE N002: geometry changes reassociate these partial sums
+    return lax.psum_scatter(flat, "dp", tiled=True)
+
+
+@numerics_contract("bitwise")
+def sharded_update(flat):
+    return scatter_grads(flat)

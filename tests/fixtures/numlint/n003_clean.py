@@ -1,0 +1,15 @@
+"""N003 negative: encode and scale-plane-paired decode travel
+together — numlint must stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+from pytorch_distributed_example_tpu.ops.quant import (
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+
+
+def roundtrip_for_wire(x):
+    q, scales = quantize_blockwise(x, 64)
+    return dequantize_blockwise(q, scales, 64)

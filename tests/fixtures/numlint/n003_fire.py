@@ -1,0 +1,13 @@
+"""N003 positive: the int8 encode's scale plane is bound to an
+underscore and discarded — the payload is undecodable without it.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+from pytorch_distributed_example_tpu.ops.quant import quantize_blockwise
+
+
+def compress_for_wire(x):
+    # MUST FIRE N003: `_scales` throws away the decode key
+    q, _scales = quantize_blockwise(x, 64)
+    return q

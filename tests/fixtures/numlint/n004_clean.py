@@ -1,0 +1,31 @@
+"""N004 negative: save records each leaf's dtype in the manifest and
+load restores from it — the round-trip is type-faithful, numlint must
+stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_checkpoint(path, tree):
+    os.makedirs(path, exist_ok=True)
+    dtypes = []
+    for i, leaf in enumerate(tree):
+        dtypes.append(str(leaf.dtype))
+        np.save(os.path.join(path, f"{i}.npy"), leaf.astype(jnp.float16))
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump({"leaves": len(tree), "dtypes": dtypes}, fh)
+
+
+def load_checkpoint(path):
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    return [
+        np.load(os.path.join(path, f"{i}.npy")).astype(dt)
+        for i, dt in enumerate(manifest["dtypes"])
+    ]

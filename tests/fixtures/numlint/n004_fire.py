@@ -1,0 +1,32 @@
+"""N004 positive: save casts leaves to half precision on the way out
+and load hands back whatever is on disk — a round-trip silently
+re-types the live param tree.
+
+Fixture corpus — linted as AST only, never imported. The function
+names match the default `[tool.numlint] checkpoint_families` entry
+`save_checkpoint:load_checkpoint`, which is what pairs them.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_checkpoint(path, tree):
+    os.makedirs(path, exist_ok=True)
+    for i, leaf in enumerate(tree):
+        # MUST FIRE N004: the f16 cast is never undone on load
+        np.save(os.path.join(path, f"{i}.npy"), leaf.astype(jnp.float16))
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump({"leaves": len(tree)}, fh)
+
+
+def load_checkpoint(path):
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    return [
+        np.load(os.path.join(path, f"{i}.npy"))
+        for i in range(manifest["leaves"])
+    ]

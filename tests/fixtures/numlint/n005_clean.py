@@ -1,0 +1,17 @@
+"""N005 negative: split before each draw — every consumption sees a
+fresh key, numlint must stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import jax
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+@numerics_contract("token_exact")
+def sample_pair_ok(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    return a, b

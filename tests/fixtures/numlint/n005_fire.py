@@ -1,0 +1,18 @@
+"""N005 positive: one PRNG key consumed by two samplers with no
+split/fold_in between them, on a token-exact path — both draws see
+the same stream, and replay forks.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import jax
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+@numerics_contract("token_exact")
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    # MUST FIRE N005: `key` was already consumed by the draw above
+    b = jax.random.normal(key, (4,))
+    return a, b

@@ -1,0 +1,24 @@
+"""N006 negative: the wall clock is read OUTSIDE the trace and passed
+in as data; iteration inside the trace is over a sorted tuple —
+numlint must stay quiet.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def host_timestamp():
+    # clean: host code, not traced — the value enters as an argument
+    return time.time()
+
+
+@jax.jit
+def stamped_scale_ok(x, t):
+    acc = x * t
+    for s in (2, 3, 5):
+        acc = acc + jnp.float32(s)
+    return acc

@@ -1,0 +1,24 @@
+"""N006 positive: host nondeterminism inside a traced context — a
+wall-clock read and a set-literal iteration under jit. The clock value
+is baked into the trace on one run and replayed on every other; set
+order is hash-seed dependent, so the traced program itself differs
+between processes.
+
+Fixture corpus — linted as AST only, never imported.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped_scale(x):
+    # MUST FIRE N006: traced-in wall clock
+    t = time.time()
+    acc = x * t
+    # MUST FIRE N006: set iteration order feeds the trace
+    for s in {2, 3, 5}:
+        acc = acc + jnp.float32(s)
+    return acc

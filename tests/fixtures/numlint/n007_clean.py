@@ -1,0 +1,34 @@
+"""N007 negative: a tolerance contract verified TIGHTER than its
+declared envelope (and a bitwise claim compared exactly) — numlint
+must stay quiet.
+
+Fixture corpus — linted as AST only, never imported (pytest does not
+collect it either: the filename does not match test_*.py).
+"""
+
+import numpy as np
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+@numerics_contract("tolerance", rtol=5e-2, atol=5e-3)
+def lossy_mean(x):
+    return x.mean()
+
+
+@numerics_contract("bitwise")
+def exact_step(p, g):
+    return p - 0.1 * g
+
+
+def test_lossy_mean_envelope():
+    got = lossy_mean(np.ones(8))
+    # clean: tighter than the declared rtol=5e-2/atol=5e-3 envelope
+    np.testing.assert_allclose(got, 1.0, rtol=1e-2, atol=1e-3)
+
+
+def test_exact_step_bitwise():
+    a = exact_step(np.ones(4), np.ones(4))
+    b = exact_step(np.ones(4), np.ones(4))
+    # clean: bitwise claim compared exactly
+    assert a.tobytes() == b.tobytes()

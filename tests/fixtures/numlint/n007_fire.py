@@ -1,0 +1,23 @@
+"""N007 positive: a test verifies a BITWISE contract with a nonzero
+tolerance — it would pass on an implementation that violates the
+claim.
+
+Fixture corpus — linted as AST only, never imported (pytest does not
+collect it either: the filename does not match test_*.py).
+"""
+
+import numpy as np
+
+from pytorch_distributed_example_tpu.numerics import numerics_contract
+
+
+@numerics_contract("bitwise")
+def sharded_step(p, g):
+    return p - 0.1 * g
+
+
+def test_sharded_step_parity():
+    a = sharded_step(np.ones(4), np.ones(4))
+    b = sharded_step(np.ones(4), np.ones(4))
+    # MUST FIRE N007: a bitwise claim admits no tolerance
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

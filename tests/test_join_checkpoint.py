@@ -190,7 +190,7 @@ class TestCheckpoint:
         for a, b in zip(
             jax.tree_util.tree_leaves(p_next), jax.tree_util.tree_leaves(p2_next)
         ):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)  # numlint: disable=N007 -- compares one train step taken by two INDEPENDENTLY COMPILED programs after the restore (step vs step2), not the checkpoint byte round-trip; save/load's bitwise claim is verified exactly by the manifest-dtype tests
 
 class TestShardedCheckpoint:
     """torch.distributed.checkpoint (DCP) parity over orbax: per-shard

@@ -1,0 +1,116 @@
+"""Unified lint driver gate (ISSUE 18 satellite): one command runs all
+four guard-plane analyzers against their committed baselines and emits
+ONE merged SARIF artifact with one `runs` entry per tool — the tier-1
+self-gate for the whole static-analysis surface.
+
+The per-tool semantics (baselines, suppressions, severities) are NOT
+re-tested here — each tool's own self-gate covers that; this file pins
+the driver contract: all four planes run, the artifact merges them in
+order, a failing or crashing plane fails the single exit code."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_example_tpu.tools import lint as unified
+
+from tests._mp_util import REPO
+
+EXPECTED_ORDER = ["distlint", "proglint", "storelint", "numlint"]
+
+
+class TestDriverGate:
+    """The exact ISSUE CLI as a subprocess over the real repo."""
+
+    @pytest.fixture(scope="class")
+    def cli(self, tmp_path_factory):
+        out = tmp_path_factory.mktemp("sarif") / "lint.sarif"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.lint",
+                "--sarif-out",
+                str(out),
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=600,
+        )
+        return proc, out
+
+    def test_exit_zero(self, cli):
+        proc, _ = cli
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_all_four_planes_reported(self, cli):
+        proc, _ = cli
+        for name in EXPECTED_ORDER:
+            assert f"{name}: rc=0" in proc.stderr, proc.stderr
+
+    def test_merged_artifact_has_one_run_per_tool(self, cli):
+        _, out = cli
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "2.1.0"
+        names = [r["tool"]["driver"]["name"] for r in doc["runs"]]
+        assert names == EXPECTED_ORDER
+        # every run carries its own rule metadata (merged, not mashed)
+        prefixes = {"distlint": "R", "proglint": "J",
+                    "storelint": "S", "numlint": "N"}
+        for run in doc["runs"]:
+            name = run["tool"]["driver"]["name"]
+            rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+            assert rules, name
+            assert all(r.startswith(prefixes[name]) for r in rules), name
+
+    def test_no_new_unbaselined_results(self, cli):
+        _, out = cli
+        doc = json.loads(out.read_text())
+        for run in doc["runs"]:
+            news = [
+                r
+                for r in run.get("results", [])
+                if r.get("baselineState") == "new"
+            ]
+            assert not news, (run["tool"]["driver"]["name"], news)
+
+
+class TestDriverSemantics:
+    def test_only_subset_runs_in_process(self):
+        merged, rcs = unified.run_all(REPO, only=["numlint"])
+        assert list(rcs) == ["numlint"]
+        assert rcs["numlint"] == 0
+        assert [
+            r["tool"]["driver"]["name"] for r in merged["runs"]
+        ] == ["numlint"]
+
+    def test_failing_plane_fails_the_single_exit_code(self, tmp_path):
+        # a minimal root whose numlint scan fires: the driver must
+        # propagate that plane's failure through the one exit code
+        (tmp_path / "mod.py").write_text(
+            "from pytorch_distributed_example_tpu.ops.quant import "
+            "quantize_blockwise\n"
+            "def leak(x):\n"
+            "    q, _scales = quantize_blockwise(x, 64)\n"
+            "    return q\n"
+        )
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.numlint]\npaths = [\"mod.py\"]\nexclude = []\n"
+            "[tool.distlint]\npaths = [\"mod.py\"]\nexclude = []\n"
+        )
+        rc = unified.main(
+            ["--root", str(tmp_path), "--only", "numlint"]
+        )
+        assert rc == 1
+
+    def test_tool_table_matches_baseline_files(self):
+        import os
+
+        for name, _, baseline in unified.TOOLS:
+            assert os.path.isfile(os.path.join(REPO, baseline)), (
+                f"{name}'s committed ratchet {baseline} is missing — "
+                "the unified gate would silently run baseline-less"
+            )

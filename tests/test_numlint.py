@@ -1,0 +1,284 @@
+"""numlint unit tests (ISSUE 18): rule corpus, contract registry, and
+the geometry parity sweeper's machinery.
+
+The static half is pinned against `tests/fixtures/numlint/` — one
+module per rule with a positive site (must fire) and a negative site
+(the corrected numerics, must stay clean). The dynamic half is pinned
+on the sweep subjects run in-process on the session's 8 virtual CPU
+devices: bitwise parity across world sizes for the ZeRO update, the
+planner schedule matrix, codec envelopes, batch-packing-invariant PRNG
+streams, and the jaxpr bisector's localization of a seeded
+reduction-order perturbation."""
+
+import ast
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu import numerics
+from pytorch_distributed_example_tpu.tools import numlint as nl
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "numlint")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    cfg = nl.NumlintConfig(paths=["."], exclude=[])
+    findings, project = nl.lint(FIXTURES, cfg)
+    return findings, project
+
+
+def _active(findings, rule=None):
+    return [
+        f
+        for f in findings
+        if not f.suppressed and (rule is None or f.rule == rule)
+    ]
+
+
+class TestContractRegistry:
+    def test_decorator_registers_without_wrapping(self):
+        @numerics.numerics_contract("bitwise", note="test")
+        def fn(x):
+            return x
+
+        # no wrapper: jit/donation/inspection see the original function
+        assert fn(3) == 3
+        assert fn.__numerics_contract__["tier"] == "bitwise"
+        assert numerics.contract_of(fn)["tier"] == "bitwise"
+
+    def test_bad_tier_and_misplaced_tolerance_rejected(self):
+        with pytest.raises(ValueError):
+            numerics.numerics_contract("exactish")
+        with pytest.raises(ValueError):
+            numerics.numerics_contract("bitwise", rtol=1e-5)
+
+    def test_static_harvest_matches_decorator(self):
+        cfg = nl.NumlintConfig(paths=["."], exclude=[])
+        _, project = nl.lint(FIXTURES, cfg)
+        contracts = nl.harvest_contracts(project)
+        by_name = {s.fi.name: s for s in contracts.values()}
+        assert by_name["train_step"].tier == "bitwise"
+        assert by_name["approx_update"].tier == "tolerance"
+        assert by_name["approx_update"].rtol == pytest.approx(1e-5)
+        assert by_name["sample_pair"].tier == "token_exact"
+
+    def test_reach_propagates_down_call_edges(self):
+        cfg = nl.NumlintConfig(paths=["."], exclude=[])
+        _, project = nl.lint(FIXTURES, cfg)
+        contracts = nl.harvest_contracts(project)
+        reach = nl.contract_reach(project, contracts)
+        scatter = next(
+            fi
+            for m in project.modules.values()
+            for fi in m.functions.values()
+            if fi.name == "scatter_grads"
+        )
+        tiers = reach[id(scatter)]
+        assert "bitwise" in tiers
+        # the chain names the contract root for the human debugging it
+        assert tiers["bitwise"][0].endswith("sharded_update")
+
+
+class TestRulesOnFixtures:
+    """Each rule fires on its positive site only; cleans stay silent."""
+
+    def test_rule_coverage_is_exact(self, fixture_findings):
+        findings, _ = fixture_findings
+        fired = {f.rule for f in _active(findings)}
+        assert fired == set(nl.RULES)
+        for f in _active(findings):
+            assert f.path.endswith("_fire.py"), f
+
+    def test_n001_matmul_precision(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N001")
+        assert f.path == "n001_fire.py"
+        assert "preferred_element_type" in f.message
+        assert "train_step" in " ".join(f.trace)
+
+    def test_n002_reduction_order(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N002")
+        assert f.path == "n002_fire.py"
+        assert "psum_scatter" in f.message
+
+    def test_n002_whitelist_silences(self):
+        cfg = nl.NumlintConfig(
+            paths=["."],
+            exclude=[],
+            parity_preserving=["n002_fire.py::scatter_grads"],
+        )
+        findings, _ = nl.lint(FIXTURES, cfg)
+        assert not _active(findings, "N002")
+
+    def test_n003_scale_plane(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N003")
+        assert f.path == "n003_fire.py"
+        assert "_scales" in f.message
+
+    def test_n003_unpaired_decoder_when_isolated(self):
+        # linted alone (no clean fixture supplying the decode call),
+        # the encoder also fires the decoder-never-called arm
+        cfg = nl.NumlintConfig(paths=["n003_fire.py"], exclude=[])
+        findings, _ = nl.lint(FIXTURES, cfg)
+        msgs = [f.message for f in _active(findings, "N003")]
+        assert any("never called" in m for m in msgs), msgs
+
+    def test_n004_dtype_skew(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N004")
+        assert f.path == "n004_fire.py"
+        assert "astype" in f.message
+
+    def test_n005_key_reuse(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N005")
+        assert f.path == "n005_fire.py"
+        assert "consumed twice" in f.message
+
+    def test_n006_host_nondeterminism_both_arms(self, fixture_findings):
+        findings, _ = fixture_findings
+        fs = _active(findings, "N006")
+        assert {f.path for f in fs} == {"n006_fire.py"}
+        msgs = " ".join(f.message for f in fs)
+        assert "time.time()" in msgs and "set" in msgs
+
+    def test_n007_tolerance_vs_tier(self, fixture_findings):
+        findings, _ = fixture_findings
+        (f,) = _active(findings, "N007")
+        assert f.path == "n007_fire.py"
+        assert "bitwise" in f.message
+
+    def test_suppression_comment_silences_with_reason(self, tmp_path):
+        src = (FIXTURES + "/n005_fire.py",)
+        with open(src[0], encoding="utf-8") as fh:
+            text = fh.read()
+        text = text.replace(
+            "b = jax.random.normal(key, (4,))",
+            "b = jax.random.normal(key, (4,))  # numlint: disable=N005"
+            " -- deliberate common-random-numbers pairing",
+        )
+        (tmp_path / "n005_suppressed.py").write_text(text)
+        cfg = nl.NumlintConfig(paths=["."], exclude=[])
+        findings, _ = nl.lint(str(tmp_path), cfg)
+        n005 = [f for f in findings if f.rule == "N005"]
+        assert n005 and all(f.suppressed for f in n005)
+
+
+class TestFingerprints:
+    def test_stable_across_line_moves(self, fixture_findings):
+        findings, _ = fixture_findings
+        (before,) = _active(findings, "N005")
+        with open(
+            os.path.join(FIXTURES, "n005_fire.py"), encoding="utf-8"
+        ) as fh:
+            text = fh.read()
+        # the same defect shifted down two lines must keep its identity
+        # (that is what lets the baseline ratchet survive refactors)
+        moved = text.replace(
+            "import jax\n", "import jax\n\n# moved\n", 1
+        )
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            with open(
+                os.path.join(td, "n005_fire.py"), "w", encoding="utf-8"
+            ) as fh:
+                fh.write(moved)
+            cfg = nl.NumlintConfig(paths=["."], exclude=[])
+            findings2, _ = nl.lint(td, cfg)
+        (after,) = [f for f in findings2 if f.rule == "N005"]
+        assert after.line != before.line
+        assert after.fingerprint == before.fingerprint
+
+
+class TestSweepMachinery:
+    def test_bisector_localizes_structural_reorder(self):
+        import jax.numpy as jnp
+
+        def a(x):
+            return jnp.cumsum(x / 3.0)
+
+        def b(x):
+            return jnp.cumsum(x) / 3.0
+
+        x = jnp.arange(5, dtype=jnp.float32)
+        msg = nl.first_divergence(a, b, (x,))
+        assert "first divergent eqn #1" in msg
+        assert "div" in msg or "cumsum" in msg
+
+    def test_bisector_value_replay_on_identical_structure(self):
+        import jax.numpy as jnp
+
+        # structurally identical programs, different constants: only
+        # value prefix replay can localize this
+        def a(x):
+            return jnp.sum(x * 2.0) + 1.0
+
+        def b(x):
+            return jnp.sum(x * 2.0000002) + 1.0
+
+        x = jnp.arange(5, dtype=jnp.float32)
+        msg = nl.first_divergence(a, b, (x,))
+        assert "first divergent eqn #1" in msg, msg
+        assert "mul" in msg, msg
+
+    def test_zero_update_parity_world3(self):
+        # world=3 is the geometry power-of-two worlds can't stand in
+        # for: the mean division is inexact there
+        res = nl._run_zero_update({"world": 3})
+        assert res["ok"], res["detail"]
+
+    def test_perturbed_update_caught_at_world3(self):
+        res = nl._run_zero_update(
+            {"world": 3}, rs_impl=nl._perturbed_reduce_scatter_mean
+        )
+        assert not res["ok"]
+        assert "first divergent eqn #" in res["detail"], res["detail"]
+
+    def test_perturbation_invisible_at_power_of_two_world(self):
+        # dividing by 2 is exact in IEEE — the revert is bitwise-silent
+        # here, which is exactly why the sweep matrix carries world=3
+        # and the revert gate only counts non-power-of-two geometries
+        res = nl._run_zero_update(
+            {"world": 2}, rs_impl=nl._perturbed_reduce_scatter_mean
+        )
+        assert res["ok"], res["detail"]
+
+    def test_prng_stream_packing_invariance(self):
+        r1 = nl._run_prng_stream({"world": 1})
+        r4 = nl._run_prng_stream({"world": 4})
+        assert r1["ok"] and r4["ok"]
+        assert r1["hash"] == r4["hash"]
+
+    def test_codec_envelope_holds(self):
+        res = nl._run_codec_roundtrip({"codec": "blockwise", "block": 8})
+        assert res["ok"], res["detail"]
+
+    def test_planner_force_restricts_matrix(self, monkeypatch):
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        geoms = nl._geoms_plan(quick=False)
+        assert geoms and all(g["schedule"] == "ring" for g in geoms)
+
+    def test_quick_matrix_is_bounded(self):
+        for subj in nl.SUBJECTS.values():
+            assert len(subj.geometries(True)) <= 2
+
+
+class TestConfig:
+    def test_defaults_whitelist_zero_wire_ops(self):
+        cfg = nl.load_config(REPO_ROOT)
+        joined = " ".join(cfg.parity_preserving)
+        assert "reduce_scatter_mean" in joined
+        assert "quantize_kv:dequantize_kv" in cfg.codec_families
+
+    def test_malformed_family_entry_rejected(self):
+        cfg = nl.NumlintConfig(
+            paths=["."], exclude=[], codec_families=["no_colon_here"]
+        )
+        with pytest.raises(ValueError, match="producer:consumer"):
+            nl.lint(FIXTURES, cfg)

@@ -1,0 +1,147 @@
+"""numlint self-gate: the numerics/determinism-plane analyzer over the
+repo's OWN contract registry — the tier-1 contract mirroring
+`tests/test_distlint_self.py` / `test_storelint_self.py`:
+
+  * zero unsuppressed error findings over the real tree (every
+    suppression carries a reason; the triage is done, the ratchet
+    holds);
+  * the committed `.numlint-baseline.json` is EMPTY — the ratchet
+    starts and stays at zero entries (the naive first-run count is
+    recorded for history only);
+  * the exact ISSUE CLI (`--format sarif --baseline
+    .numlint-baseline.json`) exits 0 as a subprocess with
+    structurally-valid SARIF 2.1.0 carrying numlint/v1
+    partialFingerprints;
+  * the quick geometry parity sweep (`--sweep --quick --seed-revert
+    pr10`, i.e. TDX_NUMLINT_SWEEP=quick) exits 0: every registered
+    contract holds bitwise across the quick geometry matrix AND the
+    seeded PR 10 ZeRO reduction-order revert is caught and localized
+    to a first divergent jaxpr eqn.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_example_tpu.tools import numlint as nl
+
+from tests._mp_util import REPO
+
+BASELINE = os.path.join(REPO, ".numlint-baseline.json")
+
+
+class TestRepoTreeClean:
+    def test_zero_unsuppressed_findings(self):
+        findings, _ = nl.lint(REPO, nl.load_config(REPO))
+        active = [
+            f
+            for f in findings
+            if not f.suppressed and f.severity == "error"
+        ]
+        assert not active, "\n".join(
+            f"{f.path}:{f.line} {f.rule} {f.message}" for f in active
+        )
+
+    def test_repo_registers_all_three_tiers(self):
+        # the registry is what the sweep drives: losing a tier means a
+        # whole contract class silently stops being swept
+        findings, project = nl.lint(REPO, nl.load_config(REPO))
+        contracts = nl.harvest_contracts(project)
+        tiers = {site.tier for site in contracts.values()}
+        assert tiers == {"bitwise", "tolerance", "token_exact"}, tiers
+
+    def test_baseline_is_committed_and_empty(self):
+        with open(BASELINE, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert doc["tool"] == "numlint"
+        assert doc["findings"] == [], (
+            "the numlint ratchet starts (and must stay) at zero — "
+            "fix or suppress findings instead of baselining them"
+        )
+        # history: the naive pre-triage run surfaced real work
+        assert doc["naive_first_run_count"] >= 1
+
+
+class TestSarifCliGate:
+    """The exact ISSUE CLI as a subprocess: exit 0, valid SARIF."""
+
+    @pytest.fixture(scope="class")
+    def cli(self):
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.numlint",
+                "--format",
+                "sarif",
+                "--baseline",
+                ".numlint-baseline.json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=600,
+        )
+
+    def test_exit_zero(self, cli):
+        assert cli.returncode == 0, cli.stdout + cli.stderr
+
+    def test_sarif_shape(self, cli):
+        doc = json.loads(cli.stdout)
+        assert doc["version"] == "2.1.0"
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "numlint"
+        rules = {r["id"] for r in driver["rules"]}
+        assert {f"N{i:03d}" for i in range(1, 8)} <= rules
+        for r in doc["runs"][0]["results"]:
+            assert r["partialFingerprints"]["numlint/v1"]
+        assert not [
+            r
+            for r in doc["runs"][0]["results"]
+            if r.get("baselineState") == "new"
+        ]
+
+
+class TestSweepCliGate:
+    """`--sweep --seed-revert pr10` under TDX_NUMLINT_SWEEP=quick IS
+    the tier-1 dynamic gate: the shipped contracts hold across the
+    quick geometry matrix, the seeded ZeRO reduction-order revert must
+    be caught AND localized to a first divergent eqn."""
+
+    @pytest.fixture(scope="class")
+    def cli(self):
+        env = dict(os.environ)
+        env["TDX_NUMLINT_SWEEP"] = "quick"
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytorch_distributed_example_tpu.tools.numlint",
+                "--sweep",
+                "--seed-revert",
+                "pr10",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+            timeout=600,
+        )
+
+    def test_exit_zero(self, cli):
+        assert cli.returncode == 0, cli.stdout + cli.stderr
+
+    def test_every_subject_swept_clean(self, cli):
+        for name in nl.SUBJECTS:
+            assert f"subject '{name}'" in cli.stdout, cli.stdout
+        assert "parity-clean" in cli.stdout
+        assert "DIVERGED —" not in cli.stdout.split("seed-revert")[0]
+
+    def test_revert_caught_and_localized(self, cli):
+        out = cli.stdout
+        assert "DIVERGED (required)" in out, out
+        assert "first divergent eqn #" in out, out
+        assert "still has teeth" in out, out
