@@ -273,6 +273,22 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # disaggregated prefill/decode pools (ISSUE 19): decode-step
+            # p99 under a long-prompt burst, colocated chunked-prefill
+            # engine vs the split pools with live KV migration — TPOT
+            # isolation x + the two-pool autoscale trace; token identity
+            # asserted inside the bench
+            "serve_disagg",
+            [sys.executable, "benchmarks/serve_bench.py", "--trace",
+             "disagg"]
+            + (
+                ["--preset", "tiny", "--requests", "12", "--slots", "4"]
+                if q
+                else ["--preset", "small", "--requests", "24"]
+            ),
+            {},
+        ),
+        (
             # prefix-sharing paged KV (ISSUE 12): shared-preamble trace
             # replayed with the radix prefix cache on vs off — >= 3x
             # TTFT target + pool-bytes/request reduction, token

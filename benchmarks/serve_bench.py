@@ -70,9 +70,23 @@ finishes the trace. Figures of merit: recovery_time_s (checkpoint
 stamp -> first post-restore token), tokens replayed, and goodput
 degradation vs an uninterrupted replay — with token-identity asserted.
 
+`--trace disagg` is the disaggregated-serving row (ISSUE 19), two
+halves. (1) TPOT isolation: steady decode-heavy requests are mid-
+stream when a burst of LONG prompts arrives; the colocated chunked-
+prefill engine pays for the burst's prefill chunks inside the SAME
+steps that advance decode, while the disagg deployment's decode pool
+(its own engine, its own chips) keeps stepping pure decode — the
+figure of merit is the decode-pool step-time p99 during the burst,
+colocated over disagg, with token identity between the two regimes
+asserted. (2) A two-pool autoscale trace on a deterministic virtual
+clock: a prefill burst craters TTFT attainment (the prefill pool's
+signal) and then sustained decode pressure craters TPOT attainment
+(the decode pool's signal) — each pool's controller resizes on its own
+evidence and the trace records that neither touched the other.
+
 Usage: python benchmarks/serve_bench.py [--preset small|base]
     [--slots 8] [--requests 48] [--rate 0] [--seed 0] [--bf16]
-    [--trace bimodal|longburst|capacity|multitenant|recovery]
+    [--trace bimodal|longburst|capacity|multitenant|recovery|disagg]
     [--prefill-chunk 32] [--tp N] [--kv-quant]
 
 Measured (CPU fallback, defaults): engine 318.8 tok/s vs static 102.5 —
@@ -279,6 +293,7 @@ def main():
         "--trace",
         choices=[
             "bimodal", "longburst", "capacity", "multitenant", "recovery",
+            "disagg",
         ],
         default="bimodal",
         help="bimodal: goodput vs static (PR 4 row); longburst: "
@@ -286,7 +301,9 @@ def main():
         "fixed-pool-bytes concurrency, int8 KV vs f32 (ISSUE 7 row); "
         "multitenant: gold-p99-TTFT-under-overload protection vs FIFO "
         "collapse (ISSUE 8); recovery: kill-mid-traffic restore row "
-        "(ISSUE 8)",
+        "(ISSUE 8); disagg: prefill/decode pool split — decode TPOT "
+        "isolation under a prefill burst vs the colocated chunked-"
+        "prefill engine + the two-pool autoscale trace (ISSUE 19)",
     )
     ap.add_argument(
         "--kv-quant", action="store_true",
@@ -681,6 +698,301 @@ def main():
         )
         if on_tpu():
             persist_result("serve_recovery", rec)
+        return
+
+    if args.trace == "disagg":
+        from pytorch_distributed_example_tpu.serve import ClassSpec
+        from pytorch_distributed_example_tpu.serve.autoscale import (
+            Autoscaler,
+            AutoscalePolicy,
+        )
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            DisaggRouter,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        chunk = args.prefill_chunk
+        n = args.requests
+        n_steady = max(4, n // 3)
+        n_burst = n - n_steady
+        slots = max(args.slots, n_steady + 2)
+        steady = [  # decode-heavy: short prompt, long budget
+            (int(gen.integers(12, 21)), 48) for _ in range(n_steady)
+        ]
+        burst = [  # prefill-heavy: long prompt, tiny budget
+            (int(gen.integers(96, 129)), 3) for _ in range(n_burst)
+        ]
+        s_prompts = [
+            gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32)
+            for p, _ in steady
+        ]
+        b_prompts = [
+            gen.integers(0, cfg.vocab_size, (p,)).astype(np.int32)
+            for p, _ in burst
+        ]
+
+        def mk_engine(role, **kw):
+            return ServeEngine(
+                model, params, slots=slots, min_bucket=8,
+                prefill_chunk_tokens=chunk, kv_quant=args.kv_quant,
+                role=role, **kw,
+            )
+
+        # warm every program (prefill chunk, first token, attach, step)
+        # outside the timed windows, including the migration landing
+        warm = DisaggRouter(
+            HashStore(),
+            lambda i: mk_engine("prefill"),
+            lambda i: mk_engine("decode"),
+        )
+        warm.submit(s_prompts[0], 3, rid="w0", seed=0)
+        warm.submit(b_prompts[0], 2, rid="w1", seed=0)
+        warm.run(max_steps=10_000)
+
+        def steady_decoding(eng):
+            return any(
+                r is not None and r.rid.startswith("s") and s in eng._decoding
+                for s, r in enumerate(eng._slot_req)
+            )
+
+        def submit_steady(submit):
+            for i, (p, (_pl, budget)) in enumerate(zip(s_prompts, steady)):
+                submit(p, budget, rid=f"s{i}", seed=i)
+
+        def submit_burst(submit):
+            for i, (p, (_pl, budget)) in enumerate(zip(b_prompts, burst)):
+                submit(p, budget, rid=f"b{i}", seed=1000 + i)
+
+        # -- colocated baseline: one chunked-prefill engine ----------------
+        colo = mk_engine("both")
+        submit_steady(colo.submit)
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            colo.step()
+            if steady_decoding(colo) and not colo._prefilling:
+                break
+        submit_burst(colo.submit)
+        colo_lat = []  # step time while steady decodes under the burst
+        while colo.pending:
+            s0 = time.perf_counter()
+            colo.step()
+            dt = time.perf_counter() - s0
+            if steady_decoding(colo) and len(colo.completions) < n:
+                colo_lat.append(dt)
+        span_colo = time.perf_counter() - t0
+
+        # -- disagg: prefill pool + decode pool over the store -------------
+        router = DisaggRouter(
+            HashStore(),
+            lambda i: mk_engine("prefill"),
+            lambda i: mk_engine("decode"),
+        )
+        submit_steady(router.submit)
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            router.step()
+            if router.migrations >= n_steady:
+                break  # every steady request now lives on the decode pool
+        submit_burst(router.submit)
+        dis_lat = []  # DECODE POOL step time under the same burst
+        real_step = router.decode.step
+
+        def timed_decode_step():
+            s0 = time.perf_counter()
+            busy = real_step()
+            dt = time.perf_counter() - s0
+            decode_eng = router.decode.engines()[0][1]
+            if steady_decoding(decode_eng) and len(router.completions) < n:
+                dis_lat.append(dt)
+            return busy
+
+        router.decode.step = timed_decode_step
+        router.run(max_steps=100_000)
+        span_dis = time.perf_counter() - t0
+
+        token_identical = {
+            r: c.tokens for r, c in colo.completions.items()
+        } == {r: c.tokens for r, c in router.completions.items()}
+        assert token_identical, "disagg diverged from colocated"
+        p99_colo = percentile(colo_lat, 99)
+        p99_dis = percentile(dis_lat, 99)
+
+        # -- two-pool autoscale trace on a deterministic virtual clock -----
+        # Phase A: a prefill burst craters TTFT attainment -> the prefill
+        # pool's controller (signal="ttft") scales out, decode holds.
+        # Phase B: sustained decode pressure (more migrants than decode
+        # slots -> landings defer, TPOT inflates) -> the decode pool's
+        # controller (signal="tpot") scales out, prefill holds.
+        t = [0.0]
+
+        def vclock():
+            return t[0]
+
+        classes = {
+            "": ClassSpec(priority=0, ttft_slo_s=0.25, tpot_slo_s=0.015)
+        }
+
+        def mk_vengine(role):
+            # decode slots > prefill slots: phase B must fit every
+            # request into a prefill slot AT ONCE (so handoff holds
+            # cannot back TTFT up) while still exceeding decode slots
+            return ServeEngine(
+                model, params,
+                slots=3 if role == "prefill" else 4, min_bucket=8,
+                prefill_chunk_tokens=chunk, classes=classes,
+                clock=vclock, role=role,
+            )
+
+        vrouter = DisaggRouter(
+            HashStore(),
+            lambda i: mk_vengine("prefill"),
+            lambda i: mk_vengine("decode"),
+            clock=vclock,
+        )
+        pol = dict(
+            target_class="", breach_polls=2, cooldown_out_s=2.0,
+            queue_high=1e9, occupancy_low=0.0, max_replicas=3,
+        )  # occupancy_low=0.0: scale-in unsatisfiable — the trace
+        # demonstrates WHERE capacity is added, not hysteresis
+        a_pre = Autoscaler(
+            vrouter.prefill,
+            AutoscalePolicy(signal="ttft", **pol),
+            clock=vclock, window_s=3.0,
+        )
+        a_dec = Autoscaler(
+            vrouter.decode,
+            AutoscalePolicy(signal="tpot", **pol),
+            clock=vclock, window_s=3.0,
+        )
+
+        def run_phase(limit):
+            for k in range(limit):
+                busy = vrouter.step()
+                t[0] += 0.01
+                if k % 5 == 4:
+                    a_pre.poll()
+                    a_dec.poll()
+                if not busy:
+                    break
+            for _ in range(20):  # drain polls: the breaching TPOT
+                t[0] += 0.05     # rows land WITH the last completions
+                a_pre.poll()
+                a_dec.poll()
+
+        # phase A: long prompts (3 chunks each, serialized on one
+        # replica -> TTFT backs up past its SLO) with budgets long
+        # enough that the landing hop amortizes out of TPOT — prefill
+        # is the rate limiter, so migrants never queue on decode
+        for i in range(12):
+            vrouter.submit(
+                gen.integers(0, cfg.vocab_size, (96,)).astype(np.int32),
+                8, rid=f"A{i}", seed=i, arrival_time=t[0],
+            )
+        run_phase(4000)
+        phase_a = {
+            "prefill_replicas": vrouter.prefill.num_replicas,
+            "decode_replicas": vrouter.decode.num_replicas,
+            "ttft_attainment": vrouter.prefill.window_view(
+                window_s=1e9
+            )["classes"][""]["slo_attainment"],
+        }
+        t[0] += 5.0  # age phase A's evidence out of every window
+        # phase B: six one-chunk prompts — every one gets a prefill
+        # slot immediately (2 replicas x 3 slots, TTFT unharmed), but
+        # only 4 decode slots: the overflow waits a full generation
+        # for a landing slot and its TPOT blows the SLO
+        for i in range(6):
+            vrouter.submit(
+                gen.integers(0, cfg.vocab_size, (8,)).astype(np.int32),
+                60, rid=f"B{i}", seed=100 + i, arrival_time=t[0],
+            )
+        run_phase(8000)
+        phase_b = {
+            "prefill_replicas": vrouter.prefill.num_replicas,
+            "decode_replicas": vrouter.decode.num_replicas,
+            "tpot_attainment": vrouter.decode.window_view(
+                window_s=1e9
+            )["classes"][""]["tpot_attainment"],
+        }
+        timeline = [
+            dict(e.to_state(), pool=pool.name)
+            for pool in (vrouter.prefill, vrouter.decode)
+            for e in pool.events
+        ]
+        pools_independent = (
+            phase_a["prefill_replicas"] > 1
+            and phase_a["decode_replicas"] == 1
+            and phase_b["decode_replicas"]
+            > phase_a["decode_replicas"]
+            and phase_b["prefill_replicas"]
+            == phase_a["prefill_replicas"]
+        )
+        if not pools_independent:
+            print(
+                f"WARNING: autoscale trace not cleanly independent: "
+                f"A={phase_a} B={phase_b}",
+                file=sys.stderr,
+            )
+
+        rec = emit(
+            "serve_disagg_tpot_isolation_x",
+            p99_colo / max(p99_dis, 1e-9),
+            "x",
+            # decode-pool step time while the prefill burst is in flight
+            # and steady requests decode: the colocated engine's steps
+            # carry the burst's prefill chunks, the disagg decode
+            # pool's do not
+            decode_step_p99_colocated_ms=round(p99_colo * 1e3, 3),
+            decode_step_p99_disagg_ms=round(p99_dis * 1e3, 3),
+            decode_step_p50_colocated_ms=round(
+                percentile(colo_lat, 50) * 1e3, 3
+            ),
+            decode_step_p50_disagg_ms=round(
+                percentile(dis_lat, 50) * 1e3, 3
+            ),
+            token_identical=token_identical,
+            migrations=router.migrations,
+            migration_retries=router.migration_retries,
+            replays=router.replays,
+            makespan_colocated_s=round(span_colo, 3),
+            makespan_disagg_s=round(span_dis, 3),
+            n_steady=n_steady,
+            n_burst=n_burst,
+            steady_tpot_p99_colocated_ms=round(
+                percentile(
+                    [
+                        c.tpot_s
+                        for r, c in colo.completions.items()
+                        if r.startswith("s")
+                    ],
+                    99,
+                ) * 1e3, 3,
+            ),
+            steady_tpot_p99_disagg_ms=round(
+                percentile(
+                    [
+                        c.tpot_s
+                        for r, c in router.completions.items()
+                        if r.startswith("s")
+                    ],
+                    99,
+                ) * 1e3, 3,
+            ),
+            autoscale_pools_independent=pools_independent,
+            autoscale_phase_a=phase_a,
+            autoscale_phase_b=phase_b,
+            autoscale_timeline=timeline,
+            prefill_chunk_tokens=chunk,
+            chunk_blocks=4,
+            preset=args.preset,
+            slots=slots,
+            dtype=str(jnp.dtype(cfg.dtype).name),
+            platform=jax.devices()[0].platform,
+            device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+            timing="readback_barrier",
+        )
+        if on_tpu():
+            persist_result("serve_disagg", rec)
         return
 
     if args.trace == "longburst":

@@ -153,6 +153,34 @@ Named injection points wired in this package:
                                                     a retried or abandoned
                                                     sweep is idempotent; the
                                                     next leader re-walks it)
+    serve.migrate.send                             (disagg KV migration,
+                                                    before a finished
+                                                    prefill's paged blocks
+                                                    are published under
+                                                    serve/migrate/{rid} —
+                                                    fired with the prefill
+                                                    slot still frozen and
+                                                    nothing published, so a
+                                                    transient fault retries
+                                                    the IDENTICAL payload
+                                                    and a crash replays the
+                                                    request from seed)
+    serve.migrate.recv                             (before a decode-pool
+                                                    engine lands a migrated
+                                                    request's blocks — fired
+                                                    with nothing landed and
+                                                    the store payload
+                                                    intact, so a retried
+                                                    receive re-lands the
+                                                    same bytes idempotently)
+    serve.pool.assign                              (before a worker writes
+                                                    its generation-scoped
+                                                    prefill/decode role
+                                                    claim — fired with
+                                                    nothing claimed; the
+                                                    claim itself is a CAS,
+                                                    so a retry adopts
+                                                    whatever role won)
     train.step                                     (for worker scripts; fired
                                                     by user training loops)
 
@@ -251,6 +279,9 @@ KNOWN_POINTS = frozenset({
     "serve.worker.register",
     "serve.restore_geometry",
     "serve.worker.gc",
+    "serve.migrate.send",
+    "serve.migrate.recv",
+    "serve.pool.assign",
     "train.step",
 })
 

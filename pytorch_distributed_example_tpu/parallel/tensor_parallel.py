@@ -199,26 +199,38 @@ def shard_kv_pool(tree, mesh, axis: str = "tp"):
     graceful degradation `sharding.spec_for` applies to params.
     """
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+
+    def leaf(x):
+        return jax.device_put(
+            x, NamedSharding(jmesh, kv_pool_spec(x, jmesh, axis))
+        )
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def kv_pool_spec(x, mesh, axis: str = "tp"):
+    """The pool layout rule for ONE leaf: 4-d (nblk, bs, KV, Dh) K/V
+    pools and 3-d (nblk, bs, KV) scale planes (the int8 pool's
+    per-token scales) both shard on their KV-head axis — the
+    dequant-in-gather multiply then partitions alongside the payload
+    gather with no resharding; anything else replicates. Factored out
+    of `shard_kv_pool` so the disagg migration plane can compute the
+    DESTINATION mesh's specs for `dtensor.redistribute_tree` — a
+    migrated block payload lands shard→shard under exactly the layout
+    the decode engine's pool already holds."""
+    from jax.sharding import PartitionSpec as P
 
     jmesh = getattr(mesh, "jax_mesh", mesh)
     size = dict(jmesh.shape)[axis]
-
-    def leaf(x):
-        # 4-d (nblk, bs, KV, Dh) K/V pools and 3-d (nblk, bs, KV) scale
-        # planes (the int8 pool's per-token scales) both shard on their
-        # KV-head axis — the dequant-in-gather multiply then partitions
-        # alongside the payload gather with no resharding
-        ndim = getattr(x, "ndim", 0)
-        if ndim == 4 and x.shape[2] % size == 0:
-            spec = P(None, None, axis, None)
-        elif ndim == 3 and x.shape[2] % size == 0:
-            spec = P(None, None, axis)
-        else:
-            spec = P()
-        return jax.device_put(x, NamedSharding(jmesh, spec))
-
-    return jax.tree_util.tree_map(leaf, tree)
+    ndim = getattr(x, "ndim", 0)
+    if ndim == 4 and x.shape[2] % size == 0:
+        return P(None, None, axis, None)
+    if ndim == 3 and x.shape[2] % size == 0:
+        return P(None, None, axis)
+    return P()
 
 
 def replicate_tree(tree, mesh):

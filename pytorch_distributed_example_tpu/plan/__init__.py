@@ -31,16 +31,18 @@ from typing import Optional
 
 import numpy as np
 
-from . import driver, executor, probe, schedules, topology
+from . import driver, executor, probe, schedules, topology, transfer
 from .planner import CollectivePlanner
 from .schedules import Plan, Round, Step
 from .topology import Topology
+from .transfer import chunk_spans, schedule_migration
 
 __all__ = [
     "CollectivePlanner", "Plan", "Round", "Step", "Topology",
     "active_for_group", "enable_for_group", "planner_for_group",
     "maybe_lower", "ddp_comm_hook", "reset_group",
-    "driver", "executor", "probe", "schedules", "topology",
+    "schedule_migration", "chunk_spans",
+    "driver", "executor", "probe", "schedules", "topology", "transfer",
 ]
 
 _ENV = "TDX_COLLECTIVE_PLANNER"

@@ -69,6 +69,13 @@ class AutoscalePolicy:
     target class's TTFT SLO."""
 
     target_class: str = ""
+    # which windowed attainment the controller steers on: "ttft"
+    # (first-token latency — the colocated default, and the PREFILL
+    # pool of a disaggregated deployment) or "tpot" (per-decoded-token
+    # latency — the DECODE pool's signal; `ClassSpec.tpot_slo_s` sets
+    # the objective). Two pools each running their own Autoscaler with
+    # their own signal is exactly the serve/disagg control plane.
+    signal: str = "ttft"
     slo_floor: float = 0.99  # scale-out band: windowed attainment below
     slo_ceiling: float = 1.0  # scale-in needs attainment AT the ceiling
     queue_high: float = 4.0  # mean queued/replica forcing scale-out
@@ -92,6 +99,10 @@ class AutoscalePolicy:
         if self.breach_polls < 1:
             raise ValueError(
                 f"breach_polls must be >= 1, got {self.breach_polls}"
+            )
+        if self.signal not in ("ttft", "tpot"):
+            raise ValueError(
+                f"signal must be 'ttft' or 'tpot', got {self.signal!r}"
             )
 
 
@@ -170,8 +181,14 @@ class Autoscaler:
         """The scalar signals one poll steers on, extracted from the
         merged window view (kept on the Decision for replay)."""
         row = view["classes"].get(self.policy.target_class, {})
+        att_key = (
+            "tpot_attainment"
+            if self.policy.signal == "tpot"
+            else "slo_attainment"
+        )
         return {
-            "attainment": row.get("slo_attainment"),
+            "signal": self.policy.signal,
+            "attainment": row.get(att_key),
             "queue_per_replica": view["queue_depth_mean_per_replica"],
             "occupancy": view["occupancy_mean"],
             "pool_utilization": view["pool_utilization_mean"],

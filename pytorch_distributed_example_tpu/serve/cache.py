@@ -117,6 +117,30 @@ def _copy_block_fn():
     return jax.jit(copy, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=8)
+def _import_blocks_fn():
+    """Jitted migration scatter — lands an `export_blocks` payload onto
+    the destination pool's physical blocks (the disagg receive path).
+
+    Same donated in-place-update discipline as `_copy_block_fn`; the
+    payload tree rides alongside the pool tree (K, V, and quantized
+    pools' scale planes all in one tree_map), so int8 bytes and their
+    scales scatter together with no requantization. Compiles once per
+    (pool shapes, payload block count) — block counts are bounded by
+    `blocks_per_seq`, so the executable set stays small."""
+    import jax
+
+    def imp(tree, payload, idx):
+        def leaf(buf, pay):
+            if buf.ndim == 0:
+                return buf
+            return buf.at[idx].set(pay.astype(buf.dtype))
+
+        return jax.tree_util.tree_map(leaf, tree, payload)
+
+    return jax.jit(imp, donate_argnums=(0,))
+
+
 class SlotKVCache:
     """Slot-managed KV cache over `model`'s decode path.
 
@@ -628,6 +652,44 @@ class PagedKVCache:
         holders, so eviction feasibility math must not count them)."""
         return sum(
             1 for b in self._slot_blocks[slot] if self._refcount[b] == 1
+        )
+
+    # -- migration payloads (serve/disagg/) --------------------------------
+    def export_blocks(self, block_ids: Sequence[int]):
+        """Host-side snapshot of the given physical blocks across every
+        pool leaf, in table order — the KV MIGRATION payload. The
+        gather is RAW: int8 payloads and their f32 scale planes come
+        out bit-for-bit (no dequant round-trip), which is what makes a
+        migrated quantized request token-exact on the landing pool.
+        Returns a tree shaped like the pool with the block axis cut to
+        `len(block_ids)`; scalar leaves pass through untouched."""
+        idx = np.asarray(list(block_ids), np.int64)
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda buf: (
+                buf
+                if getattr(buf, "ndim", 0) == 0
+                else np.asarray(buf[idx])
+            ),
+            self.tree,
+        )
+
+    def import_blocks(self, dst_ids: Sequence[int], payload) -> None:
+        """Land an `export_blocks` payload onto this pool's physical
+        blocks `dst_ids` (same order, same count) — the migration
+        receive. One jitted donated scatter per payload shape
+        (`_import_blocks_fn`), the same in-place-update discipline as
+        copy-on-write; under a TP mesh the replicated payload scatters
+        into the KV-head-sharded pool shard-locally via GSPMD. Bytes
+        land verbatim — dtype mismatches are a caller bug and raise."""
+        import jax
+        import jax.numpy as jnp
+
+        self.tree = _import_blocks_fn()(
+            self.tree,
+            jax.tree_util.tree_map(jnp.asarray, payload),
+            jnp.asarray(np.asarray(list(dst_ids), np.int32)),
         )
 
     def __repr__(self) -> str:

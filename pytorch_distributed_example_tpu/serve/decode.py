@@ -39,9 +39,28 @@ from typing import Optional
 from ..models.generate import init_cache, sample_logits
 from .cache import land_slot
 
-__all__ = ["slot_programs", "paged_programs", "sync_slot_lanes"]
+__all__ = [
+    "slot_programs",
+    "paged_programs",
+    "sync_slot_lanes",
+    "carry_key",
+]
 
 _DECODE_PATH = "pytorch_distributed_example_tpu/serve/decode.py"
+
+
+def carry_key(seed: int):
+    """The post-first-token carry key as a PURE function of the seed —
+    exactly what `first_token` leaves in the slot's rng lane after its
+    one `split` (key = PRNGKey(seed); key, sub = split(key); sample
+    with sub; carry key). Because the carry is seed-derived and never
+    depends on device state, a DIFFERENT engine (the disagg decode
+    pool, `serve/disagg/`) can reconstruct the in-flight RNG stream
+    from the request metadata alone and continue sampling
+    token-identically — migration never serializes device RNG lanes."""
+    import jax
+
+    return jax.random.split(jax.random.PRNGKey(seed))[0]
 
 
 def _register_programs(family: str, **programs):

@@ -122,7 +122,7 @@ from .queue import (
     RequestQueue,
 )
 
-__all__ = ["ServeEngine"]
+__all__ = ["Handoff", "ServeEngine"]
 
 # Faults the engine absorbs by requeueing work (the retry layer's
 # transient taxonomy): injected connection resets and dropped requests.
@@ -139,6 +139,24 @@ class _Prefill:
 
     req: Request
     pos: int = 0
+
+
+@dataclass
+class Handoff:
+    """A finished prefill FROZEN for migration (``role="prefill"``
+    engines, `serve/disagg/`): the slot keeps its blocks and request
+    binding — nothing decodes, nothing frees — until the migration
+    plane exports the KV payload and `release_handoff` returns the slot
+    to the pool. `first` is the token the prefill engine already
+    sampled (its one key-split off `req.seed`), so the decode pool
+    starts FROM the migrated first token with the carry key
+    reconstructed purely from the seed (`serve/decode.py::carry_key`)
+    — no device RNG state crosses the wire."""
+
+    req: Request
+    slot: int
+    length: int
+    first: int
 
 
 class ServeEngine:
@@ -165,7 +183,20 @@ class ServeEngine:
         class_preemption: bool = True,
         prefix_cache: bool = False,
         precompiled=None,
+        role: str = "both",
     ):
+        # disaggregated serving (serve/disagg/): "prefill" freezes
+        # finished prefills as Handoffs for the migration plane instead
+        # of decoding them; "decode" admits work only via
+        # attach_migrated (its queue holds preempted migrants awaiting
+        # router pickup); "both" is the colocated PR 6 engine,
+        # bit-for-bit.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill', or 'decode', got {role!r}"
+            )
+        self.role = role
+        self._handoff: List[Handoff] = []
         self.model = model
         self.params = params["params"] if "params" in params else params
         self.cfg = model.cfg
@@ -370,6 +401,15 @@ class ServeEngine:
         return bucket_for(L, self.buckets)
 
     def _admit(self) -> int:
+        if self.role == "decode":
+            # decode-pool engines admit ONLY via attach_migrated;
+            # anything queued here is a preempted migrant waiting for
+            # the disagg router to route it back through a prefill
+            # engine (replay-from-seed)
+            return 0
+        return self._admit_queue()
+
+    def _admit_queue(self) -> int:
         """Backfill free slots from the queue (continuous batching:
         called at the top of every step, so retirement and admission
         interleave mid-stream). The queue's weighted round-robin picks
@@ -676,21 +716,37 @@ class ServeEngine:
                     self.cache.slot_blocks(slot),
                 )
             del self._prefilling[slot]
-            self._decoding.add(slot)
             self._slot_tokens[slot] = [first]
             now = self.clock()
             req.first_token_time = now
             self._note_recovery(now)
-            if (self.eos_id is not None and first == self.eos_id) or (
-                req.max_new_tokens == 1
-            ):
-                self._retire(
-                    slot,
-                    now,
-                    "eos"
-                    if self.eos_id is not None and first == self.eos_id
-                    else "length",
+            done = (
+                "eos"
+                if self.eos_id is not None and first == self.eos_id
+                else "length"
+                if req.max_new_tokens == 1
+                else None
+            )
+            if done is not None:
+                # single-token completions finish HERE regardless of
+                # role — there is nothing left to decode, so migrating
+                # would move blocks only to free them
+                self._decoding.add(slot)
+                self._retire(slot, now, done)
+            elif self.role == "prefill":
+                # freeze for migration: the slot keeps its request and
+                # blocks (the migration plane exports them), the lane
+                # stays parked. TTFT is DONE — the first token exists —
+                # so it lands in this pool's window now; completion
+                # (and TPOT) will land in the decode pool's.
+                self._handoff.append(
+                    Handoff(req=req, slot=slot, length=L, first=first)
                 )
+                self.metrics.record_first_token(
+                    now, now - req.arrival_time, klass=req.klass
+                )
+            else:
+                self._decoding.add(slot)
             if budget is not None and spent >= budget:
                 return  # budget spent: yield to decode
 
@@ -757,6 +813,10 @@ class ServeEngine:
         self._slot_tokens[slot] = []
         self._prefilling.pop(slot, None)
         self._decoding.discard(slot)
+        # an evicted FROZEN handoff replays through prefill again —
+        # its record must go, or the migration plane would export a
+        # freed (possibly reallocated) slot's blocks
+        self._handoff = [h for h in self._handoff if h.slot != slot]
         self.queue.requeue_front(req)
         self.cache.free(slot)
         self._reserved -= self._worst_blocks(req)
@@ -832,11 +892,17 @@ class ServeEngine:
         # holds real blocks (chunks land as they arrive) — hand the step
         # a view with those rows invalidated so the parked lane's
         # garbage write drops instead of scattering into the request's
-        # own block 0. Retired rows are already all-invalid via free().
+        # own block 0. FROZEN handoff slots are the same hazard with
+        # higher stakes: their blocks are the migration payload, and a
+        # parked-lane write would corrupt KV mid-flight. Retired rows
+        # are already all-invalid via free().
         bt = self.cache.block_tables
-        if self._prefilling:
+        frozen = sorted(self._prefilling) + sorted(
+            h.slot for h in self._handoff
+        )
+        if frozen:
             bt = bt.copy()
-            bt[sorted(self._prefilling)] = self.cache.invalid_block
+            bt[frozen] = self.cache.invalid_block
         (
             self.cache.tree,
             self._dev_lengths,
@@ -1017,8 +1083,92 @@ class ServeEngine:
             self.queue.requeue_front(req)
             self.cache.free(s)
             self._reserved -= self._worst_blocks(req)
+        # frozen handoffs were in-flight too (their slots held requests)
+        # — requeued above; drop the stale migration records
+        self._handoff = []
         self.metrics.record_requeue(len(inflight))
         return len(inflight)
+
+    # -- disaggregated handoff / landing (serve/disagg/) -------------------
+    def pop_handoffs(self) -> List[Handoff]:
+        """Drain the frozen-handoff list (``role="prefill"``). The
+        slots stay frozen — blocks pinned, lanes parked — until the
+        caller exports each payload and calls `release_handoff`; an
+        engine step between pop and release is safe (frozen rows are
+        invalidated in `step`), but an eviction in that window makes
+        the record stale, which `release_handoff` detects by request
+        identity."""
+        out, self._handoff = self._handoff, []
+        return out
+
+    def release_handoff(self, h: Handoff) -> None:
+        """Return a migrated handoff's slot + blocks to the pool —
+        called AFTER the payload is durably published (store-first
+        discipline: a crash between publish and release just re-sends
+        identical bytes). No-op when the slot no longer holds `h.req`
+        (evicted since the pop — the request is replaying anyway)."""
+        if self._slot_req[h.slot] is not h.req:
+            return
+        self._slot_req[h.slot] = None
+        self._slot_tokens[h.slot] = []
+        self.cache.free(h.slot)
+        self._reserved -= self._worst_blocks(h.req)
+
+    def attach_migrated(
+        self, req: Request, length: int, first: int, payload
+    ) -> Optional[int]:
+        """Land a migrated prefill on this (decode-pool) engine: claim
+        a slot, import the KV block payload
+        (`serve/cache.py::import_blocks` — raw int8 + scale planes, so
+        the landed pool bytes are BITWISE the prefill pool's), and seed
+        the slot's lanes with the already-sampled first token and the
+        carry key reconstructed from `req.seed`
+        (`serve/decode.py::carry_key`). Decode then proceeds exactly as
+        if this engine had prefilled locally — token-exact by
+        construction. Returns the slot, or None when this engine cannot
+        hold the request right now (caller retries / picks another
+        replica; nothing was mutated)."""
+        from .decode import carry_key
+
+        if self.role == "prefill":
+            raise DistError("prefill-pool engines cannot land migrations")
+        worst = self._worst_blocks(req)
+        if self.conservative_admission and (
+            self._reserved + worst > self.cache.num_blocks
+        ):
+            return None
+        slot = self.cache.allocate()
+        if slot is None:
+            return None
+        if not self.cache.ensure_blocks(slot, length - 1):
+            self.cache.free(slot)
+            return None
+        self.cache.import_blocks(self.cache.slot_blocks(slot), payload)
+        (
+            self._dev_lengths,
+            self._dev_tokens,
+            self._dev_rngs,
+        ) = self._attach(
+            self._dev_lengths,
+            self._dev_tokens,
+            self._dev_rngs,
+            slot,
+            length,
+            np.int32(first),
+            carry_key(req.seed),
+        )
+        self.cache.lengths[slot] = length
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = [first]
+        self._decoding.add(slot)
+        self._reserved += worst
+        self.metrics.record_admit()
+        if req.first_token_time is None:
+            # migration meta normally carries the prefill-side stamp;
+            # fall back to "now" so TPOT stays finite either way
+            req.first_token_time = self.clock()
+        self._note_recovery(self.clock())
+        return slot
 
     # -- introspection -----------------------------------------------------
     @property

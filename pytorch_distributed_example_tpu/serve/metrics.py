@@ -47,7 +47,7 @@ from typing import Dict, Iterable, Optional
 
 from .queue import DEFAULT_CLASS, ClassSpec
 
-__all__ = ["ServeMetrics", "percentile"]
+__all__ = ["ServeMetrics", "merge_window_views", "percentile"]
 
 
 def percentile(values: Iterable[float], q: float) -> float:
@@ -63,6 +63,63 @@ def percentile(values: Iterable[float], q: float) -> float:
     hi = min(lo + 1, len(xs) - 1)
     frac = pos - lo
     return float(xs[lo] * (1 - frac) + xs[hi] * frac)
+
+
+def merge_window_views(views, now, window_s=None) -> Dict:
+    """Merge per-engine `ServeMetrics.window_view` dicts into one
+    pool-wide view — EXACT merging (sums of raw slo_met/slo_n and
+    tpot_slo_met/tpot_slo_n counts, never averages of ratios — two
+    replicas at 10/10 and 0/1 must read 10/11, not 0.5). Queue depth
+    sums across members (total backlog); occupancy and pool pressure
+    average (per-chip pressure is what admission feels).
+
+    The ONE definition of the merge, shared by the DP `ServeRouter`
+    (PR 14) and each pool of the disaggregated router (`serve/disagg`)
+    — both controllers must steer on identically-shaped evidence."""
+    views = list(views)
+    classes: Dict[str, Dict] = {}
+    for v in views:
+        for k, row in v["classes"].items():
+            agg = classes.setdefault(
+                k,
+                {
+                    "completed": 0, "shed": 0, "slo_met": 0, "slo_n": 0,
+                    "tpot_slo_met": 0, "tpot_slo_n": 0,
+                },
+            )
+            agg["completed"] += row["completed"]
+            agg["shed"] += row["shed"]
+            agg["slo_met"] += row["slo_met"]
+            agg["slo_n"] += row["slo_n"]
+            agg["tpot_slo_met"] += row.get("tpot_slo_met", 0)
+            agg["tpot_slo_n"] += row.get("tpot_slo_n", 0)
+    for row in classes.values():
+        row["slo_attainment"] = (
+            round(row["slo_met"] / row["slo_n"], 4)
+            if row["slo_n"]
+            else None
+        )
+        row["tpot_attainment"] = (
+            round(row["tpot_slo_met"] / row["tpot_slo_n"], 4)
+            if row["tpot_slo_n"]
+            else None
+        )
+    n = max(len(views), 1)
+    qd = sum(v["queue_depth_mean"] for v in views)
+    return {
+        "window_s": views[0]["window_s"] if views else window_s,
+        "now": now,
+        "replicas": len(views),
+        "classes": classes,
+        "queue_depth_mean": round(qd, 3),
+        "queue_depth_mean_per_replica": round(qd / n, 3),
+        "occupancy_mean": round(
+            sum(v["occupancy_mean"] for v in views) / n, 4
+        ),
+        "pool_utilization_mean": round(
+            sum(v["pool_utilization_mean"] for v in views) / n, 4
+        ),
+    }
 
 
 class ServeMetrics:
@@ -156,10 +213,17 @@ class ServeMetrics:
                 "slo_met": 0,
                 "ttft": deque(maxlen=self._max_latency_samples),
                 "e2e": deque(maxlen=self._max_latency_samples),
-                # (t, ttft_s, slo_ok-or-None) completion samples for the
-                # trailing-window reduction; (t,) shed samples likewise
+                # (t, ttft_s, slo_ok-or-None) first-token samples for
+                # the trailing-window reduction; (t,) shed samples and
+                # (t, tpot_s, tpot_ok-or-None) completion-time TPOT
+                # samples likewise. TTFT samples land at FIRST TOKEN
+                # (completion for a colocated engine, prefill handoff
+                # for a disaggregated prefill pool) and TPOT samples at
+                # completion — the two pools of a disagg deployment
+                # steer on their own stream.
                 "win": deque(maxlen=self._max_latency_samples),
                 "shed_win": deque(maxlen=self._max_latency_samples),
+                "tpot_win": deque(maxlen=self._max_latency_samples),
             }
             self._by_class[klass] = st
         return st
@@ -336,7 +400,34 @@ class ServeMetrics:
                 slo_ok = ttft_s <= spec.ttft_slo_s
                 st["slo_met"] += int(slo_ok)
             st["win"].append((t, ttft_s, slo_ok))
+            # TPOT verdicts only for multi-token requests: a 1-token
+            # completion has no inter-token interval, and its 0.0 would
+            # read as a free SLO pass diluting the decode-pool signal
+            if n_tokens > 1:
+                tpot_ok = None
+                if spec is not None and spec.tpot_slo_s is not None:
+                    tpot_ok = tpot_s <= spec.tpot_slo_s
+                st["tpot_win"].append((t, tpot_s, tpot_ok))
             self._last_complete = t
+
+    def record_first_token(
+        self, t: float, ttft_s: float, klass: str = DEFAULT_CLASS
+    ) -> None:
+        """A first token served WITHOUT a completion on this engine —
+        the disaggregated prefill pool's handoff path (`serve/disagg`):
+        the request's decode (and its completion sample) happens on the
+        decode pool, but the TTFT evidence — and its SLO verdict — is
+        this pool's product, so the window sample lands here, where the
+        prefill autoscaler is looking."""
+        with self._lock:
+            st = self._class_state(klass)
+            st["ttft"].append(ttft_s)
+            spec = self._classes.get(klass)
+            slo_ok = None
+            if spec is not None and spec.ttft_slo_s is not None:
+                slo_ok = ttft_s <= spec.ttft_slo_s
+                st["slo_met"] += int(slo_ok)
+            st["win"].append((t, ttft_s, slo_ok))
 
     # -- reporting ---------------------------------------------------------
     def _window_view_locked(
@@ -357,6 +448,8 @@ class ServeMetrics:
             samples = [s for s in st["win"] if cutoff <= s[0] <= now]
             verdicts = [s[2] for s in samples if s[2] is not None]
             ttfts = [s[1] for s in samples]
+            tpots = [s for s in st["tpot_win"] if cutoff <= s[0] <= now]
+            tpot_verdicts = [s[2] for s in tpots if s[2] is not None]
             by_class[k] = {
                 "completed": len(samples),
                 "shed": sum(
@@ -373,6 +466,22 @@ class ServeMetrics:
                 ),
                 "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 3),
                 "ttft_p99_ms": round(percentile(ttfts, 99) * 1e3, 3),
+                # the decode-pool plane: per-token latency samples with
+                # their own SLO verdicts (`ClassSpec.tpot_slo_s`) —
+                # same raw-count discipline for exact merging
+                "tpot_slo_met": sum(bool(v) for v in tpot_verdicts),
+                "tpot_slo_n": len(tpot_verdicts),
+                "tpot_attainment": (
+                    round(sum(tpot_verdicts) / len(tpot_verdicts), 4)
+                    if tpot_verdicts
+                    else None
+                ),
+                "tpot_p50_ms": round(
+                    percentile([s[1] for s in tpots], 50) * 1e3, 3
+                ),
+                "tpot_p99_ms": round(
+                    percentile([s[1] for s in tpots], 99) * 1e3, 3
+                ),
             }
         steps = [s for s in self._step_win if cutoff <= s[0] <= now]
         pools = [s for s in self._pool_win if cutoff <= s[0] <= now]

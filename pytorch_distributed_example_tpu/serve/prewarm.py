@@ -71,6 +71,22 @@ __all__ = [
 _MANIFEST = "prewarm-manifest.json"
 
 
+def _engine_tp(engine) -> int:
+    """The TP degree an engine's programs were traced under — the
+    mesh's ``tp`` axis extent, 1 for unmeshed engines. Keys the
+    per-degree namespace inside a shared pre-warm dir: a disaggregated
+    deployment warms one dir for BOTH pools' degrees (prefill TP !=
+    decode TP) and each engine loads only its own shapes."""
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None:
+        return 1
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    try:
+        return int(dict(jmesh.shape).get("tp", 1))
+    except Exception:
+        return 1
+
+
 @dataclass(frozen=True, order=True)
 class GeometrySpec:
     """One geometry the autoscaler can land the gang on: `world` DP
@@ -215,37 +231,80 @@ def prewarm_engine_programs(
     ).compile()
     timings[("step", S)] = time.perf_counter() - t0
     if save_dir is not None:
-        _save_precompiled(compiled, save_dir)
+        _save_precompiled(compiled, save_dir, tp=_engine_tp(engine))
     return timings
 
 
-def _save_precompiled(compiled: Dict, save_dir: str) -> None:
-    """Serialize compiled executables + a manifest into `save_dir`.
-    Same-host, same-jax-version artifacts (the deploy contract a
-    worker fleet already satisfies); `load_precompiled` rejects
-    anything it cannot deserialize rather than crashing a worker."""
+def _save_precompiled(compiled: Dict, save_dir: str, tp: int = 1) -> None:
+    """Serialize compiled executables + a manifest into `save_dir`,
+    namespaced by TP degree. Same-host, same-jax-version artifacts
+    (the deploy contract a worker fleet already satisfies);
+    `load_precompiled` rejects anything it cannot deserialize rather
+    than crashing a worker.
+
+    The manifest MERGES: one pre-warm dir accumulates executables for
+    MULTIPLE TP degrees (a disagg deployment warms prefill-TP and
+    decode-TP passes into the same dir), each pass updating only its
+    own ``{name}:{shape}:tp{tp}`` keys. The write stays atomic
+    (tmp + replace), so a reader never sees a torn manifest — at worst
+    it sees the pre-merge one and cold-compiles the new degree."""
     from jax.experimental import serialize_executable as se
 
     os.makedirs(save_dir, exist_ok=True)
-    manifest = {}
+    path = os.path.join(save_dir, _MANIFEST)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {}
     for (name, shape), exe in compiled.items():
-        fname = f"{name}-{int(shape)}.exe"
+        fname = f"{name}-{int(shape)}-tp{int(tp)}.exe"
         with open(os.path.join(save_dir, fname), "wb") as f:
             pickle.dump(se.serialize(exe), f)
-        manifest[f"{name}:{int(shape)}"] = fname
-    tmp = os.path.join(save_dir, _MANIFEST + ".tmp")
+        manifest[f"{name}:{int(shape)}:tp{int(tp)}"] = fname
+    tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f)
-    os.replace(tmp, os.path.join(save_dir, _MANIFEST))
+    os.replace(tmp, path)
 
 
-def load_precompiled(save_dir: str) -> Dict[Tuple[str, int], object]:
-    """Deserialize a pre-warm pass's executables. Returns {} when the
-    directory has no (complete) manifest and silently drops entries
-    that fail to load — a worker with a stale or foreign pre-warm dir
-    degrades to cold compiles, it never refuses to start."""
+def _parse_manifest_key(key: str) -> Optional[Tuple[str, int, int]]:
+    """``name:shape[:tpN]`` -> (name, shape, tp); legacy two-part keys
+    (pre-disagg manifests) are tp=1. None for anything malformed."""
+    parts = key.split(":")
+    try:
+        if len(parts) == 2:
+            return parts[0], int(parts[1]), 1
+        if len(parts) == 3 and parts[2].startswith("tp"):
+            return parts[0], int(parts[1]), int(parts[2][2:])
+    except ValueError:
+        return None
+    return None
+
+
+def load_precompiled(
+    save_dir: str, tp: Optional[int] = None, mesh=None
+) -> Dict[Tuple[str, int], object]:
+    """Deserialize a pre-warm pass's executables FOR ONE TP DEGREE —
+    selected explicitly (``tp=``) or from the engine's mesh shape
+    (``mesh=``; its ``tp`` axis extent, 1 when absent/None). A shared
+    multi-degree dir thus hands each pool exactly the executables its
+    geometry traced; legacy manifests without the tp suffix load as
+    tp=1. Returns {} when the directory has no (complete) manifest and
+    silently drops entries that fail to load — a worker with a stale
+    or foreign pre-warm dir degrades to cold compiles, it never
+    refuses to start."""
     from jax.experimental import serialize_executable as se
 
+    if tp is None:
+        if mesh is None:
+            tp = 1
+        else:
+            jmesh = getattr(mesh, "jax_mesh", mesh)
+            try:
+                tp = int(dict(jmesh.shape).get("tp", 1))
+            except Exception:
+                tp = 1
     path = os.path.join(save_dir, _MANIFEST)
     try:
         with open(path) as f:
@@ -254,11 +313,14 @@ def load_precompiled(save_dir: str) -> Dict[Tuple[str, int], object]:
         return {}
     out: Dict[Tuple[str, int], object] = {}
     for key, fname in manifest.items():
-        name, _, shape = key.rpartition(":")
+        parsed = _parse_manifest_key(key)
+        if parsed is None or parsed[2] != int(tp):
+            continue
+        name, shape, _tp = parsed
         try:
             with open(os.path.join(save_dir, fname), "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
-            out[(name, int(shape))] = se.deserialize_and_load(
+            out[(name, shape)] = se.deserialize_and_load(
                 payload, in_tree, out_tree
             )
         except Exception:

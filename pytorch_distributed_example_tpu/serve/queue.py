@@ -76,7 +76,11 @@ class ClassSpec:
     important — sheds last, preempts first); `weight` is the SWRR
     admission share; `ttft_slo_s` is the class's TTFT objective,
     reported as SLO attainment in the metrics (advisory — admission
-    is driven by priority/weight, not by the target). `share_prefix`
+    is driven by priority/weight, not by the target); `tpot_slo_s` is
+    the per-decoded-token objective the DECODE pool of a disaggregated
+    deployment steers on (`serve/disagg`) — TTFT attainment drives the
+    prefill pool, TPOT attainment the decode pool, so the two SLOs get
+    independent fields. `share_prefix`
     opts the class's requests into the CROSS-TENANT prefix-cache scope
     (default off: a tenant's cached prompt prefixes serve only its own
     later requests; on, requests share one global scope with every
@@ -88,6 +92,7 @@ class ClassSpec:
     weight: int = 1
     ttft_slo_s: Optional[float] = None
     share_prefix: bool = False
+    tpot_slo_s: Optional[float] = None
 
     def __post_init__(self):
         if self.weight < 1:

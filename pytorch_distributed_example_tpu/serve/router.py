@@ -495,57 +495,22 @@ class ServeRouter:
         now: Optional[float] = None,
     ) -> Dict:
         """Gang-wide rolling window: the per-replica `ServeMetrics`
-        windows merged EXACTLY (sums of raw slo_met/slo_n counts, not
-        averages of ratios — two replicas at 10/10 and 0/1 must read
-        10/11, not 0.5). Queue depth sums across replicas (total
-        backlog); occupancy and pool pressure average (per-chip
-        pressure is what admission feels). The controller steers on
-        this view."""
+        windows merged EXACTLY by `metrics.merge_window_views` (sums of
+        raw slo_met/slo_n counts, not averages of ratios; queue depth
+        sums, occupancy/pool pressure average). The controller steers
+        on this view — the SAME merge the disaggregated pools use, so
+        one- and two-pool controllers read identical evidence."""
+        from .metrics import merge_window_views
+
         if now is None:
             now = float(self.clock())
         with self._lock:
             replicas = dict(self._replicas)
-        views = {
-            r: eng.metrics.window_view(window_s=window_s, now=now)
-            for r, eng in sorted(replicas.items())
-        }
-        classes: Dict[str, Dict] = {}
-        for v in views.values():
-            for k, row in v["classes"].items():
-                agg = classes.setdefault(
-                    k,
-                    {"completed": 0, "shed": 0, "slo_met": 0, "slo_n": 0},
-                )
-                agg["completed"] += row["completed"]
-                agg["shed"] += row["shed"]
-                agg["slo_met"] += row["slo_met"]
-                agg["slo_n"] += row["slo_n"]
-        for row in classes.values():
-            row["slo_attainment"] = (
-                round(row["slo_met"] / row["slo_n"], 4)
-                if row["slo_n"]
-                else None
-            )
-        n = max(len(views), 1)
-        qd = sum(v["queue_depth_mean"] for v in views.values())
-        return {
-            "window_s": next(iter(views.values()))["window_s"]
-            if views
-            else window_s,
-            "now": now,
-            "replicas": len(views),
-            "classes": classes,
-            "queue_depth_mean": round(qd, 3),
-            "queue_depth_mean_per_replica": round(qd / n, 3),
-            "occupancy_mean": round(
-                sum(v["occupancy_mean"] for v in views.values()) / n, 4
-            ),
-            "pool_utilization_mean": round(
-                sum(v["pool_utilization_mean"] for v in views.values())
-                / n,
-                4,
-            ),
-        }
+        views = [
+            eng.metrics.window_view(window_s=window_s, now=now)
+            for _, eng in sorted(replicas.items())
+        ]
+        return merge_window_views(views, now, window_s=window_s)
 
     def snapshot(self) -> Dict:
         """JSON for the debug HTTP frontend — register the router like

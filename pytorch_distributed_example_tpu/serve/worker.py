@@ -43,6 +43,13 @@ The store contract (all keys live on the agent's rendezvous store):
 * **registration** — ``serve/worker/gen{g}/rank{r}`` (pid + geometry
   JSON) is the router's membership view; `wait_registered` is how
   tests and the front door await a formed generation.
+* **pool roles** — ``serve/role/gen{g}/rank{r}`` is a worker's
+  disaggregated pool membership (prefill/decode/both, `serve/disagg/`)
+  as a generation-scoped CAS claim (`claim_role`): replays adopt the
+  generation's recorded role, resizes change roles only by changing
+  generation, `pool_members` reads the topology, and the same
+  `gc_worker_state` sweep that retires a generation's registration
+  rows retires its role claims.
 
 Fault surface (all in `faults.KNOWN_POINTS`): ``serve.worker.start``
 fires at process start before any store key is touched — a transient
@@ -88,6 +95,8 @@ __all__ = [
     "ElasticGangScaler",
     "wait_registered",
     "worker_store_from_env",
+    "claim_role",
+    "pool_members",
 ]
 
 # Store keys. Ledger items/claims carry their scope in the key (seq /
@@ -128,6 +137,10 @@ def _done_key(rid: str) -> str:
 
 def _reg_key(gen: int, rank: int) -> str:
     return f"serve/worker/gen{gen}/rank{rank}"
+
+
+def _role_key(gen: int, rank: int) -> str:
+    return f"serve/role/gen{gen}/rank{rank}"
 
 
 def _fire_with_retry(point: str, attempts: int = 5, **ctx) -> None:
@@ -185,13 +198,62 @@ def wait_registered(
         time.sleep(0.02)
 
 
+def claim_role(store, gen: int, rank: int, role: str = "both") -> str:
+    """Publish this worker's pool membership (`prefill`/`decode`/
+    `both`) as a GENERATION-SCOPED CLAIM — a CAS on
+    `serve/role/gen{g}/rank{r}` — and return the role that WON. The CAS
+    makes role assignment idempotent across replays: a restarted worker
+    (or a planner re-issuing assignments after a transient fault)
+    adopts whatever role the generation already recorded for this rank,
+    so the two pools' geometry cannot flap mid-generation; a RESIZE
+    changes roles only by changing generation. `serve.pool.assign`
+    fires BEFORE the claim — a transient fault there retries with
+    nothing claimed, and a crash leaves the rank unclaimed for the
+    re-formed gang to claim afresh."""
+    if role not in ("both", "prefill", "decode"):
+        raise DistError(f"unknown worker role {role!r}")
+    _fire_with_retry("serve.pool.assign", rank=rank, gen=gen, role=role)
+    key = _role_key(gen, rank)
+    try:
+        won = store.compare_set(key, b"", role.encode())
+    except Exception:
+        return role  # store hiccup: run the requested role, claim is
+        #              re-attempted by the next generation's entry
+    try:
+        return (won or role.encode()).decode()
+    except Exception:
+        return role
+
+
+def pool_members(store, gen: int, n: int) -> Dict[str, List[int]]:
+    """Read generation `gen`'s claimed pool topology: role → sorted
+    ranks, for up to `n` ranks (the router/autoscaler's view of which
+    workers form the prefill pool vs the decode pool). Unclaimed ranks
+    are reported under "both" — a colocated worker serves either
+    plane."""
+    out: Dict[str, List[int]] = {"prefill": [], "decode": [], "both": []}
+    for r in range(n):
+        role = "both"
+        try:
+            if store.check([_role_key(gen, r)]):
+                role = store.get(_role_key(gen, r)).decode()
+        except Exception:
+            pass
+        out.setdefault(role, []).append(r)
+    return out
+
+
 def gc_worker_state(store, gen: int, keep: int = 2, back: int = 16) -> int:
     """Reclaim per-generation coordination rows from retired gangs:
     worker registration rows (`serve/worker/gen{g}/rank{r}`) and
     leader-election restore markers (`serve/restored/gen{g}`[+`/done`])
-    older than the newest `keep` generations. Without this every
-    resize leaked one marker pair plus one row per rank for the store
-    daemon's lifetime (storelint S005). Called by the restore leader —
+    older than the newest `keep` generations, plus retired generations'
+    pool-role claims (`serve/role/gen{g}/rank{r}` — a role claim is
+    meaningful only while its generation serves, so the sweep that
+    retires the registration rows retires the roles with them). Without
+    this every resize leaked one marker pair plus rows per rank for the
+    store daemon's lifetime (storelint S005). Called by the restore
+    leader —
     exactly one walker per generation, and by the time gen G's leader
     runs, nothing can still poll a scope older than G-1 (followers of
     a LIVE generation poll only their own marker). Returns the number
@@ -204,6 +266,8 @@ def gc_worker_state(store, gen: int, keep: int = 2, back: int = 16) -> int:
         try:
             for r in range(_MAX_RANKS):
                 if store.delete_key(_reg_key(g, r)):
+                    deleted += 1
+                if store.delete_key(_role_key(g, r)):
                     deleted += 1
             if store.delete_key(f"serve/restored/gen{g}"):
                 deleted += 1
@@ -234,11 +298,15 @@ class ServeWorker:
         claim_depth: Optional[int] = None,
         leader_wait_s: float = 10.0,
         clock=time.time,
+        role: str = "both",
     ):
         self.store = store
         self.engine = engine
         self.rank = int(rank)
         self.gen = int(gen)
+        # requested pool membership; the GENERATION's claim wins at
+        # start() (claim_role CAS) and is mirrored onto the engine
+        self.role = role
         self.poll_interval_s = poll_interval_s
         self.metrics_interval_s = metrics_interval_s
         # how much queued-but-unserved work this worker will hold: claim
@@ -263,10 +331,15 @@ class ServeWorker:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServeWorker":
         """Run the generation-entry protocol: the start fault point,
-        leader-elected geometry restore, then registration."""
+        the pool-role claim (disagg — the generation's CAS'd role wins
+        over the requested one and is mirrored onto the engine), then
+        leader-elected geometry restore and registration."""
         _fire_with_retry(
             "serve.worker.start", rank=self.rank, gen=self.gen
         )
+        self.role = claim_role(self.store, self.gen, self.rank, self.role)
+        if getattr(self.engine, "role", self.role) != self.role:
+            self.engine.role = self.role
         self._restore_geometry()
         self._register()
         return self
@@ -369,6 +442,7 @@ class ServeWorker:
                 "gen": self.gen,
                 "world": int(os.environ.get("WORLD_SIZE", "0") or 0),
                 "slots": len(self.engine._slot_req),
+                "role": self.role,
                 "t": float(self.clock()),
             }
         ).encode()

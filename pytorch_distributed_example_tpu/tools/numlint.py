@@ -1406,6 +1406,133 @@ def _geoms_prng(quick: bool) -> List[Dict[str, Any]]:
     return [{"world": w} for w in worlds]
 
 
+def _run_disagg_migration(geom: Dict[str, Any]) -> Dict[str, Any]:
+    """Token-exact subject for the disagg migration plane (serve/
+    disagg/): every completion routed prefill-pool → KV migration →
+    decode-pool must be bitwise the colocated engine's, at BOTH
+    sampling modes (greedy and temperature>0 — the carry key must
+    survive the pool hop), across heterogeneous prefill/decode TP
+    degrees and the int8 KV pool."""
+    import jax
+    import numpy as np
+
+    from ..mesh import init_device_mesh
+    from ..models import TransformerConfig, TransformerLM
+    from ..serve.disagg import DisaggRouter
+    from ..serve.engine import ServeEngine
+    from ..store import HashStore
+
+    p_tp, d_tp = geom["prefill_tp"], geom["decode_tp"]
+    kv_quant = geom["kv_quant"]
+    if len(jax.devices()) < max(p_tp, d_tp):
+        return {
+            "ok": False,
+            "detail": f"needs {max(p_tp, d_tp)} devices, "
+            f"have {len(jax.devices())}",
+            "hash": "",
+        }
+
+    cfg = TransformerConfig(
+        vocab_size=64,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        max_seq_len=32,
+        use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 4), np.int32)
+    )
+
+    def mesh_for(n):
+        if n == 1:
+            return None
+        return init_device_mesh(("tp",), (n,), devices=jax.devices()[:n])
+
+    def make(role, tp, temperature, top_k):
+        return ServeEngine(
+            model,
+            params,
+            slots=4,
+            temperature=temperature,
+            top_k=top_k,
+            block_size=4,
+            pool_blocks=64,
+            prefill_chunk_tokens=8,
+            mesh=mesh_for(tp),
+            kv_quant=kv_quant,
+            role=role,
+        )
+
+    gen = np.random.default_rng(3)
+    prompts = [
+        gen.integers(0, 64, (n,)).astype(np.int32) for n in (5, 9, 13)
+    ]
+
+    def drive(submit, run):
+        for i, p in enumerate(prompts):
+            submit(p, 6, rid=f"r{i}", seed=11 + i)
+        return {rid: c.tokens for rid, c in run().items()}
+
+    mismatches = []
+    hashes = []
+    for mode, (temp, top_k) in (
+        ("greedy", (0.0, None)),
+        ("sampled", (0.8, 8)),
+    ):
+        colo = make("both", p_tp, temp, top_k)
+
+        def run_colo(eng=colo):
+            for _ in range(4096):
+                if not eng.step():
+                    break
+            return eng.completions
+
+        base = drive(colo.submit, run_colo)
+        router = DisaggRouter(
+            HashStore(),
+            lambda i: make("prefill", p_tp, temp, top_k),
+            lambda i: make("decode", d_tp, temp, top_k),
+            chunk_blocks=2,
+        )
+        got = drive(router.submit, lambda: router.run(max_steps=4096))
+        for rid in sorted(base):
+            if got.get(rid) != base[rid]:
+                mismatches.append(
+                    f"{mode}/{rid}: colocated={base[rid]} "
+                    f"disagg={got.get(rid)}"
+                )
+        if router.migrations == 0:
+            mismatches.append(
+                f"{mode}: no migrations occurred — the disagg path "
+                "was not exercised"
+            )
+        hashes.append(
+            _tree_hash([np.asarray(base[r]) for r in sorted(base)])
+        )
+    ok = not mismatches
+    return {
+        "ok": ok,
+        "detail": "; ".join(mismatches[:3]),
+        "hash": _tree_hash(hashes),
+    }
+
+
+def _geoms_disagg(quick: bool) -> List[Dict[str, Any]]:
+    # heterogeneous TP on both sides of the migration plus the int8 KV
+    # pool: raw block transport must be invisible at every combination
+    out = [
+        {"prefill_tp": 1, "decode_tp": 1, "kv_quant": False},
+        {"prefill_tp": 1, "decode_tp": 2, "kv_quant": True},
+        {"prefill_tp": 2, "decode_tp": 1, "kv_quant": False},
+        {"prefill_tp": 2, "decode_tp": 2, "kv_quant": True},
+        {"prefill_tp": 1, "decode_tp": 1, "kv_quant": True},
+        {"prefill_tp": 2, "decode_tp": 1, "kv_quant": True},
+    ]
+    return out[:2] if quick else out
+
+
 @dataclass
 class Subject:
     name: str
@@ -1443,6 +1570,14 @@ SUBJECTS: Dict[str, Subject] = {
         "pytorch_distributed_example_tpu.serve.engine:ServeEngine.step",
         _geoms_prng,
         _run_prng_stream,
+    ),
+    "disagg_migration": Subject(
+        "disagg_migration",
+        "token_exact",
+        "pytorch_distributed_example_tpu.serve.disagg.migrate:"
+        "migrate_request",
+        _geoms_disagg,
+        _run_disagg_migration,
     ),
 }
 

@@ -1,0 +1,850 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 19).
+
+The contract under test: a request routed prefill-pool → KV migration
+→ decode-pool completes with EXACTLY the token stream the colocated
+PR 6 engine emits — greedy and sampled, int8 KV pool included, through
+decode-side capacity refusals, preemptions (replay-from-seed through
+prefill), transient migration faults, and a simulated mid-migration
+crash with a re-formed gang. Plus the satellite surfaces: the
+planner's migration schedules (`plan/transfer.py`), generation-scoped
+pool-role claims (`serve/worker.py::claim_role`), the per-pool
+autoscale signal split (TTFT vs TPOT), and the multi-TP pre-warm
+manifest (`serve/prewarm.py`).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_example_tpu import faults
+
+
+@pytest.fixture()
+def no_fault_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=2)
+def _model(max_seq_len=32):
+    """One shared (model, params) per session: the paged-program cache
+    (`serve/decode.py::paged_programs`) is keyed on the model instance,
+    so reuse keeps every engine in the file on warm executables."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_example_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        max_seq_len=max_seq_len,
+        use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    return model, params
+
+
+def _prompts(*lens, seed=0, vocab=64):
+    gen = np.random.default_rng(seed)
+    return [gen.integers(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def _tp_mesh(n):
+    import jax
+
+    from pytorch_distributed_example_tpu.mesh import init_device_mesh
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+    return init_device_mesh(("tp",), (n,), devices=jax.devices()[:n])
+
+
+def _engine(model, params, role="both", tp=1, **kw):
+    from pytorch_distributed_example_tpu.serve.engine import ServeEngine
+
+    kw.setdefault("slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("pool_blocks", 64)
+    kw.setdefault("prefill_chunk_tokens", 8)
+    mesh = _tp_mesh(tp) if tp > 1 else None
+    return ServeEngine(model, params, mesh=mesh, role=role, **kw)
+
+
+def _run_colocated(model, params, jobs, **kw):
+    """Reference completions from the colocated engine: jobs is
+    [(rid, prompt, budget, seed), ...]."""
+    eng = _engine(model, params, role="both", **kw)
+    for rid, p, budget, seed in jobs:
+        eng.submit(p, budget, rid=rid, seed=seed)
+    for _ in range(4096):
+        if not eng.step():
+            break
+    return {rid: c.tokens for rid, c in eng.completions.items()}
+
+
+def _disagg(model, params, store=None, prefill=1, decode=1, **kw):
+    from pytorch_distributed_example_tpu.serve.disagg import DisaggRouter
+    from pytorch_distributed_example_tpu.store import HashStore
+
+    p_tp = kw.pop("p_tp", 1)
+    d_tp = kw.pop("d_tp", 1)
+    d_over = kw.pop("decode_kw", {})
+    d_kw = dict(kw)
+    d_kw.update(d_over)
+    store = store if store is not None else HashStore()
+    router = DisaggRouter(
+        store,
+        lambda i: _engine(model, params, role="prefill", tp=p_tp, **kw),
+        lambda i: _engine(model, params, role="decode", tp=d_tp, **d_kw),
+        prefill_replicas=prefill,
+        decode_replicas=decode,
+        chunk_blocks=2,
+    )
+    return router, store
+
+
+def _jobs(prompts, budget=5, seed0=11):
+    return [
+        (f"r{i}", p, budget, seed0 + i) for i, p in enumerate(prompts)
+    ]
+
+
+def _submit_all(router, jobs):
+    for rid, p, budget, seed in jobs:
+        router.submit(p, budget, rid=rid, seed=seed)
+
+
+class TestTransferPlan:
+    def test_spans_cover_payload_once(self):
+        from pytorch_distributed_example_tpu.plan import (
+            chunk_spans,
+            schedule_migration,
+        )
+
+        plan = schedule_migration(10, 2, 3, chunk_blocks=4)
+        assert plan.op == "kv_migrate"
+        assert plan.world == 5
+        assert plan.topology_key == "prefill2xdecode3"
+        covered = []
+        for _rnd, src, dst, off, n in chunk_spans(plan):
+            assert 0 <= src < 2 and 2 <= dst < 5
+            covered.extend(range(off, off + n))
+        assert covered == list(range(10))  # every block exactly once
+
+    def test_rounds_use_disjoint_links(self):
+        from pytorch_distributed_example_tpu.plan import (
+            chunk_spans,
+            schedule_migration,
+        )
+
+        plan = schedule_migration(16, 2, 3, chunk_blocks=2)
+        by_round = {}
+        for rnd, src, dst, _off, _n in chunk_spans(plan):
+            by_round.setdefault(rnd, []).append((src, dst))
+        assert len(by_round) == 4  # 8 chunks / min(2,3) links
+        for links in by_round.values():
+            srcs = [s for s, _ in links]
+            dsts = [d for _, d in links]
+            # no prefill rank sends twice, no decode rank receives
+            # twice within a round: the chunks genuinely overlap
+            assert len(set(srcs)) == len(srcs)
+            assert len(set(dsts)) == len(dsts)
+
+    def test_fingerprint_pins_the_schedule(self):
+        from pytorch_distributed_example_tpu.plan import schedule_migration
+
+        a = schedule_migration(12, 2, 2, chunk_blocks=4)
+        b = schedule_migration(12, 2, 2, chunk_blocks=4)
+        c = schedule_migration(12, 2, 2, chunk_blocks=2)
+        assert a.fingerprint() == b.fingerprint()
+        assert a.fingerprint() != c.fingerprint()
+
+    def test_zero_blocks_is_an_empty_plan(self):
+        from pytorch_distributed_example_tpu.plan import (
+            chunk_spans,
+            schedule_migration,
+        )
+
+        plan = schedule_migration(0, 1, 1)
+        assert plan.rounds == ()
+        assert list(chunk_spans(plan)) == []
+
+    def test_invalid_args_rejected(self):
+        from pytorch_distributed_example_tpu.plan import schedule_migration
+
+        with pytest.raises(ValueError):
+            schedule_migration(4, 0, 1)
+        with pytest.raises(ValueError):
+            schedule_migration(4, 1, 1, chunk_blocks=0)
+        with pytest.raises(ValueError):
+            schedule_migration(-1, 1, 1)
+
+
+class TestMigrationPlane:
+    def _handoff(self, eng, prompt, budget=5, seed=3, rid="m0"):
+        eng.submit(prompt, budget, rid=rid, seed=seed)
+        for _ in range(64):
+            eng.step()
+            hs = eng.pop_handoffs()
+            if hs:
+                return hs[0]
+        raise AssertionError("prefill never froze a handoff")
+
+    def test_send_recv_roundtrip_token_exact(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            gc_migration,
+            recv_migration,
+            send_handoff,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        (prompt,) = _prompts(9)
+        ref = _run_colocated(model, params, [("m0", prompt, 5, 3)])
+        store = HashStore()
+        src = _engine(model, params, role="prefill")
+        dst = _engine(model, params, role="decode")
+        h = self._handoff(src, prompt)
+        n_chunks = send_handoff(store, src, h, chunk_blocks=2)
+        assert n_chunks >= 1
+        assert store.check(["serve/migrate/m0"])
+        slot = recv_migration(store, "m0", dst)
+        assert slot is not None
+        src.release_handoff(h)
+        assert gc_migration(store, "m0") == n_chunks + 1
+        assert not store.check(["serve/migrate/m0"])
+        for _ in range(64):
+            if not dst.step():
+                break
+        assert dst.completions["m0"].tokens == ref["m0"]
+        # the handoff slot's blocks were freed on release
+        assert src.cache.active_slots == []
+
+    def test_republication_is_byte_identical(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            send_handoff,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        (prompt,) = _prompts(9)
+        store = HashStore()
+        src = _engine(model, params, role="prefill")
+        h = self._handoff(src, prompt)
+        n = send_handoff(store, src, h, chunk_blocks=2)
+        keys = ["serve/migrate/m0"] + [
+            f"serve/migrate/m0/chunk{i}" for i in range(n)
+        ]
+        before = {k: store.get(k) for k in keys}
+        assert send_handoff(store, src, h, chunk_blocks=2) == n
+        assert {k: store.get(k) for k in keys} == before
+
+    def test_recv_refuses_torn_publication(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            gc_migration,
+            recv_migration,
+            send_handoff,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        (prompt,) = _prompts(9)
+        store = HashStore()
+        src = _engine(model, params, role="prefill")
+        dst = _engine(model, params, role="decode")
+        # no manifest at all: not an error, just "not yet"
+        assert recv_migration(store, "m0", dst) is None
+        h = self._handoff(src, prompt)
+        n = send_handoff(store, src, h, chunk_blocks=2)
+        store.delete_key("serve/migrate/m0/chunk0")
+        assert recv_migration(store, "m0", dst) is None
+        assert dst.cache.active_slots == []  # nothing was mutated
+        # GC still reclaims everything, torn or not
+        assert gc_migration(store, "m0") == n  # n-1 chunks + manifest
+        assert not store.check(["serve/migrate/m0"])
+
+    def test_gc_reclaims_chunks_without_manifest(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            gc_migration,
+            pending_rids,
+            send_handoff,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        (prompt,) = _prompts(13)
+        store = HashStore()
+        src = _engine(model, params, role="prefill")
+        h = self._handoff(src, prompt)
+        n = send_handoff(store, src, h, chunk_blocks=2)
+        # crash window: manifest died, chunks leaked
+        store.delete_key("serve/migrate/m0")
+        assert pending_rids(store, ["m0"]) == []
+        assert gc_migration(store, "m0") == n
+        assert not store.check(["serve/migrate/m0/chunk0"])
+
+    def test_release_handoff_ignores_stale_records(self, no_fault_plan):
+        model, params = _model()
+        (prompt,) = _prompts(9)
+        src = _engine(model, params, role="prefill")
+        h = self._handoff(src, prompt)
+        src.requeue_inflight()  # eviction/drain: the record went stale
+        before = src.cache.free_blocks
+        src.release_handoff(h)  # must NOT free a reused slot's blocks
+        assert src.cache.free_blocks == before
+
+
+class TestDisaggParity:
+    def _check(self, model, params, jobs, ref, no_migrations=None, **kw):
+        router, store = _disagg(model, params, **kw)
+        _submit_all(router, jobs)
+        got = {
+            rid: c.tokens
+            for rid, c in router.run(max_steps=4096).items()
+        }
+        assert got == ref
+        if no_migrations is None:
+            assert router.migrations >= len(jobs)
+        return router, store
+
+    def test_greedy_parity_two_by_two(self, no_fault_plan):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13, 7))
+        ref = _run_colocated(model, params, jobs)
+        router, _ = self._check(
+            model, params, jobs, ref, prefill=2, decode=2
+        )
+        assert router.migrations == len(jobs)
+
+    def test_sampled_parity(self, no_fault_plan):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13))
+        kw = dict(temperature=0.8, top_k=8)
+        ref = _run_colocated(model, params, jobs, **kw)
+        self._check(model, params, jobs, ref, **kw)
+
+    def test_kv_quant_parity(self, no_fault_plan):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13))
+        ref = _run_colocated(model, params, jobs, kv_quant=True)
+        self._check(model, params, jobs, ref, kv_quant=True)
+
+    def test_decode_capacity_refusal_retries_until_landed(
+        self, no_fault_plan
+    ):
+        """Decode pool with ONE slot: landings are refused while it is
+        held (attach_migrated returns None, payload stays published),
+        and every request still completes token-exact."""
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13))
+        ref = _run_colocated(model, params, jobs)
+        router, _ = self._check(
+            model,
+            params,
+            jobs,
+            ref,
+            decode_kw=dict(slots=1),
+        )
+        assert router.migration_retries > 0
+
+    def test_decode_preemption_replays_through_prefill(
+        self, no_fault_plan
+    ):
+        """A decode pool too small to hold both migrants at full
+        length: one preempts mid-decode, parks in the decode engine's
+        queue, and the router sweeps it back through prefill for a
+        full replay from seed — the PR 6 preemption contract stretched
+        across two pools, token-exact."""
+        model, params = _model()
+        # finals 21 and 25 tokens -> 6+7 blocks, pool holds 8: the
+        # migrants MUST overlap in decode and one MUST run out of pool
+        jobs = _jobs(_prompts(9, 13), budget=12)
+        ref = _run_colocated(model, params, jobs)
+        router, store = self._check(
+            model,
+            params,
+            jobs,
+            ref,
+            no_migrations=True,
+            decode_kw=dict(pool_blocks=8),
+        )
+        assert router.replays > 0
+        assert router.migrations > len(jobs)  # the replay re-migrated
+        # swept migrants' store payloads were reclaimed
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            pending_rids,
+        )
+
+        assert pending_rids(store, [j[0] for j in jobs]) == []
+
+    def test_completion_metrics_span_pools(self, no_fault_plan):
+        """TTFT is stamped on the PREFILL pool and must survive the
+        migration: the completion's ttft_s reflects the prefill-side
+        first token, not the decode-side landing."""
+        model, params = _model()
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.01
+            return t[0]
+
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            DisaggRouter,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        router = DisaggRouter(
+            HashStore(),
+            lambda i: _engine(
+                model, params, role="prefill", clock=clock
+            ),
+            lambda i: _engine(
+                model, params, role="decode", clock=clock
+            ),
+            clock=clock,
+        )
+        (prompt,) = _prompts(9)
+        router.submit(prompt, 5, rid="r0", seed=1)
+        t_submit = t[0]
+        comp = router.run(max_steps=4096)["r0"]
+        assert comp.ttft_s > 0
+        # e2e spans submit → decode completion; TTFT is a strict prefix
+        assert comp.ttft_s < comp.e2e_s
+        assert comp.e2e_s <= t[0] - t_submit + 0.011
+
+    def test_mis_roled_factories_rejected(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            DisaggRouter,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        with pytest.raises(ValueError, match="prefill"):
+            DisaggRouter(
+                HashStore(),
+                lambda i: _engine(model, params, role="both"),
+                lambda i: _engine(model, params, role="decode"),
+            )
+        with pytest.raises(ValueError, match="decode"):
+            DisaggRouter(
+                HashStore(),
+                lambda i: _engine(model, params, role="prefill"),
+                lambda i: _engine(model, params, role="both"),
+            )
+
+
+class TestDisaggChaos:
+    @pytest.mark.parametrize(
+        "point", ["serve.migrate.send", "serve.migrate.recv"]
+    )
+    def test_transient_migration_fault_absorbed_token_exact(self, point):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9))
+        ref = _run_colocated(model, params, jobs)
+        faults.install_plan(
+            [{"point": point, "action": "reset", "times": 2}],
+            export_env=False,
+        )
+        try:
+            router, _ = _disagg(model, params)
+            _submit_all(router, jobs)
+            got = {
+                rid: c.tokens
+                for rid, c in router.run(max_steps=4096).items()
+            }
+        finally:
+            faults.clear_plan()
+        assert got == ref
+        assert router.migration_retries >= 1
+
+    def test_crash_mid_migration_reforms_token_exact(self):
+        """The ISSUE's kill test, in-process: gang one publishes
+        migration payloads but dies before ANY landing (recv faulted
+        forever = the receiving side is gone). A re-formed gang on the
+        SAME store replays every request from seed, completes
+        token-exact, and the orphaned migration keys are reclaimed."""
+        from pytorch_distributed_example_tpu.serve.disagg import (
+            pending_rids,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13))
+        rids = [j[0] for j in jobs]
+        ref = _run_colocated(model, params, jobs)
+        store = HashStore()
+        faults.install_plan(
+            [
+                {
+                    "point": "serve.migrate.recv",
+                    "action": "reset",
+                    "times": -1,
+                }
+            ],
+            export_env=False,
+        )
+        try:
+            doomed, _ = _disagg(model, params, store=store)
+            _submit_all(doomed, jobs)
+            for _ in range(64):
+                doomed.step()
+            # payloads are in the store, nothing ever landed
+            assert pending_rids(store, rids)
+            assert doomed.migrations == 0
+        finally:
+            faults.clear_plan()
+        del doomed  # SIGKILL: device state and engines are gone
+        reformed, _ = _disagg(model, params, store=store)
+        _submit_all(reformed, jobs)  # replay from seed
+        got = {
+            rid: c.tokens
+            for rid, c in reformed.run(max_steps=4096).items()
+        }
+        assert got == ref
+        # the re-formed gang's completion sweep reclaimed the orphans
+        assert pending_rids(store, rids) == []
+
+    def test_scale_faults_are_pool_tagged(self, no_fault_plan):
+        """A transient fault at a POOL's scale seam aborts that pool's
+        resize only — the other pool still scales."""
+        model, params = _model()
+        router, _ = _disagg(model, params)
+        faults.install_plan(
+            [{"point": "serve.scale_out", "action": "reset", "times": 1}],
+            export_env=False,
+        )
+        try:
+            with pytest.raises(ConnectionResetError):
+                router.prefill.add_replica()
+            router.decode.add_replica()  # rule consumed by prefill
+        finally:
+            faults.clear_plan()
+        assert router.prefill.num_replicas == 1
+        assert router.decode.num_replicas == 2
+
+
+class TestPoolScaling:
+    def test_decode_scale_in_mid_flight_token_exact(self, no_fault_plan):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13, 7), budget=6)
+        ref = _run_colocated(model, params, jobs)
+        router, _ = _disagg(model, params, prefill=1, decode=2)
+        _submit_all(router, jobs)
+        for _ in range(8):
+            router.step()
+        victim = router.decode.remove_replica()
+        got = {
+            rid: c.tokens
+            for rid, c in router.run(max_steps=4096).items()
+        }
+        assert got == ref
+        assert router.decode.num_replicas == 1
+        evs = [e for e in router.decode.events if e.kind == "remove"]
+        assert evs and evs[0].replica_id == victim
+
+    def test_prefill_scale_out_in_roundtrip(self, no_fault_plan):
+        model, params = _model()
+        jobs = _jobs(_prompts(5, 9, 13, 7, 6, 8))
+        ref = _run_colocated(model, params, jobs)
+        router, _ = _disagg(model, params)
+        _submit_all(router, jobs)
+        router.prefill.add_replica()
+        for _ in range(4):
+            router.step()
+        router.prefill.remove_replica()
+        got = {
+            rid: c.tokens
+            for rid, c in router.run(max_steps=4096).items()
+        }
+        assert got == ref
+
+    def test_last_replica_not_removable(self, no_fault_plan):
+        model, params = _model()
+        router, _ = _disagg(model, params)
+        with pytest.raises(ValueError, match="last"):
+            router.prefill.remove_replica()
+        with pytest.raises(ValueError, match="last"):
+            router.decode.remove_replica()
+
+    def test_pool_windows_carry_their_own_signal(self, no_fault_plan):
+        """The control-plane split: TTFT evidence accumulates in the
+        PREFILL pool's window (stamped at handoff), TPOT + completion
+        evidence in the DECODE pool's — each autoscaler steers on its
+        own pool's view."""
+        from pytorch_distributed_example_tpu.serve.queue import ClassSpec
+
+        model, params = _model()
+        classes = {
+            "": ClassSpec(priority=0, ttft_slo_s=60.0, tpot_slo_s=60.0)
+        }
+        jobs = _jobs(_prompts(5, 9))
+        router, _ = _disagg(model, params, classes=classes)
+        _submit_all(router, jobs)
+        router.run(max_steps=4096)
+        pre = router.prefill.window_view(window_s=1e9)["classes"][""]
+        dec = router.decode.window_view(window_s=1e9)["classes"][""]
+        assert pre["slo_n"] == len(jobs)  # TTFT verdicts: prefill pool
+        assert pre["tpot_slo_n"] == 0  # no decode evidence there
+        assert dec["tpot_slo_n"] == len(jobs)  # TPOT verdicts: decode
+        assert dec["tpot_attainment"] == 1.0
+
+
+class TestAutoscaleSignals:
+    def _view(self, slo_att, tpot_att, n=2):
+        return {
+            "window_s": 5.0,
+            "now": 0.0,
+            "replicas": n,
+            "classes": {
+                "gold": {
+                    "completed": 10,
+                    "shed": 0,
+                    "slo_attainment": slo_att,
+                    "tpot_attainment": tpot_att,
+                }
+            },
+            "queue_depth_mean": 2.0,
+            "queue_depth_mean_per_replica": 1.0,
+            "occupancy_mean": 0.7,
+            "pool_utilization_mean": 0.5,
+        }
+
+    def _drive(self, views, signal):
+        from pytorch_distributed_example_tpu.serve.autoscale import (
+            Autoscaler,
+            AutoscalePolicy,
+        )
+
+        class Stub:
+            def __init__(self, views):
+                self.views, self.i, self.n = views, 0, 2
+                self.adds = 0
+
+            def window_view(self, window_s=None, now=None):
+                v = self.views[min(self.i, len(self.views) - 1)]
+                self.i += 1
+                return v
+
+            def add_replica(self):
+                self.adds += 1
+                self.n += 1
+
+            def remove_replica(self):
+                self.n -= 1
+
+            @property
+            def num_replicas(self):
+                return self.n
+
+        t = [0.0]
+        stub = Stub(views)
+        a = Autoscaler(
+            stub,
+            AutoscalePolicy(
+                target_class="gold", signal=signal, breach_polls=2
+            ),
+            clock=lambda: t[0],
+        )
+        decs = []
+        for _ in range(4):
+            decs.append(a.poll())
+            t[0] += 0.5  # stay inside cooldown_out_s: one add max
+        return stub, decs
+
+    def test_tpot_signal_steers_on_tpot_attainment(self, no_fault_plan):
+        """TPOT broken, TTFT perfect: the decode-pool policy
+        (signal='tpot') scales out; the prefill-pool policy
+        (signal='ttft') holds on the same evidence."""
+        views = [self._view(slo_att=1.0, tpot_att=0.5)] * 4
+        stub, decs = self._drive(views, "tpot")
+        assert stub.adds == 1
+        applied = [d for d in decs if d.outcome == "applied"][0]
+        assert applied.view["signal"] == "tpot"
+        assert applied.view["attainment"] == 0.5
+        stub2, _ = self._drive(views, "ttft")
+        assert stub2.adds == 0
+
+    def test_ttft_signal_unmoved_by_tpot_breach(self, no_fault_plan):
+        views = [self._view(slo_att=0.5, tpot_att=1.0)] * 4
+        stub, _ = self._drive(views, "ttft")
+        assert stub.adds == 1
+        stub2, _ = self._drive(views, "tpot")
+        assert stub2.adds == 0
+
+    def test_invalid_signal_rejected(self):
+        from pytorch_distributed_example_tpu.serve.autoscale import (
+            AutoscalePolicy,
+        )
+
+        with pytest.raises(ValueError, match="signal"):
+            AutoscalePolicy(signal="latency")
+
+
+class TestRoleClaims:
+    def test_claim_is_generation_scoped_cas(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.worker import (
+            claim_role,
+            pool_members,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        store = HashStore()
+        assert claim_role(store, 0, 0, "prefill") == "prefill"
+        # a replayed (or conflicting) claim adopts the generation's
+        # recorded winner — the pool topology cannot flap mid-gen
+        assert claim_role(store, 0, 0, "decode") == "prefill"
+        assert claim_role(store, 0, 1, "decode") == "decode"
+        # a NEW generation re-claims from scratch
+        assert claim_role(store, 1, 0, "decode") == "decode"
+        members = pool_members(store, 0, 3)
+        assert members["prefill"] == [0]
+        assert members["decode"] == [1]
+        assert members["both"] == [2]  # unclaimed rank
+
+    def test_claim_transient_fault_absorbed(self):
+        from pytorch_distributed_example_tpu.serve.worker import (
+            claim_role,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        store = HashStore()
+        faults.install_plan(
+            [
+                {
+                    "point": "serve.pool.assign",
+                    "action": "reset",
+                    "times": 2,
+                }
+            ],
+            export_env=False,
+        )
+        try:
+            assert claim_role(store, 0, 0, "decode") == "decode"
+        finally:
+            faults.clear_plan()
+
+    def test_invalid_role_rejected(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.worker import (
+            claim_role,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+        from pytorch_distributed_example_tpu.types import DistError
+
+        with pytest.raises(DistError, match="role"):
+            claim_role(HashStore(), 0, 0, "router")
+
+    def test_gc_retires_old_generations_roles(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.worker import (
+            claim_role,
+            gc_worker_state,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        store = HashStore()
+        for g in range(4):
+            claim_role(store, g, 0, "prefill")
+        assert gc_worker_state(store, gen=3, keep=2) >= 2
+        assert not store.check(["serve/role/gen0/rank0"])
+        assert not store.check(["serve/role/gen1/rank0"])
+        assert store.check(["serve/role/gen2/rank0"])
+        assert store.check(["serve/role/gen3/rank0"])
+
+    def test_worker_role_rides_registration(self, no_fault_plan):
+        from pytorch_distributed_example_tpu.serve.worker import (
+            ServeWorker,
+            wait_registered,
+        )
+        from pytorch_distributed_example_tpu.store import HashStore
+
+        model, params = _model()
+        store = HashStore(timeout=1.0)
+        w = ServeWorker(
+            store,
+            _engine(model, params),
+            rank=0,
+            gen=0,
+            role="prefill",
+        )
+        w.start()
+        assert w.role == "prefill"
+        assert w.engine.role == "prefill"  # claim mirrored onto engine
+        rows = wait_registered(store, 0, 1, timeout=2.0)
+        assert rows[0]["role"] == "prefill"
+
+
+class TestPrewarmMultiTP:
+    def test_manifest_merges_and_selects_by_tp(
+        self, tmp_path, no_fault_plan
+    ):
+        from pytorch_distributed_example_tpu.serve.prewarm import (
+            load_precompiled,
+            prewarm_engine_programs,
+        )
+
+        model, params = _model()
+        d = str(tmp_path)
+        e1 = _engine(model, params)
+        prewarm_engine_programs(e1, save_dir=d)
+        mesh2 = _tp_mesh(2)
+        e2 = _engine(model, params, tp=2)
+        prewarm_engine_programs(e2, save_dir=d)
+        # one dir, two degrees, independent selections
+        tp1 = load_precompiled(d, tp=1)
+        tp2 = load_precompiled(d, tp=2)
+        assert set(tp1) == set(tp2)  # same program/shape grid
+        assert tp1 and tp2
+        # mesh-shape selection matches the explicit degree
+        assert set(load_precompiled(d, mesh=mesh2)) == set(tp2)
+        assert set(load_precompiled(d)) == set(tp1)
+        with open(os.path.join(d, "prewarm-manifest.json")) as f:
+            manifest = json.load(f)
+        assert {k.rsplit(":", 1)[1] for k in manifest} == {"tp1", "tp2"}
+
+    def test_legacy_manifest_keys_load_as_tp1(
+        self, tmp_path, no_fault_plan
+    ):
+        from pytorch_distributed_example_tpu.serve.prewarm import (
+            load_precompiled,
+            prewarm_engine_programs,
+        )
+
+        model, params = _model()
+        d = str(tmp_path)
+        prewarm_engine_programs(_engine(model, params), save_dir=d)
+        path = os.path.join(d, "prewarm-manifest.json")
+        with open(path) as f:
+            manifest = json.load(f)
+        legacy = {  # a pre-disagg manifest: no tp suffix anywhere
+            k.rsplit(":", 1)[0]: v for k, v in manifest.items()
+        }
+        with open(path, "w") as f:
+            json.dump(legacy, f)
+        assert load_precompiled(d, tp=1)
+        assert load_precompiled(d, tp=2) == {}
+
+    def test_malformed_keys_are_skipped(self):
+        from pytorch_distributed_example_tpu.serve.prewarm import (
+            _parse_manifest_key,
+        )
+
+        assert _parse_manifest_key("step:8") == ("step", 8, 1)
+        assert _parse_manifest_key("step:8:tp4") == ("step", 8, 4)
+        assert _parse_manifest_key("step") is None
+        assert _parse_manifest_key("step:x") is None
+        assert _parse_manifest_key("step:8:mesh4") is None
+        assert _parse_manifest_key("step:8:tpx") is None
