@@ -157,6 +157,18 @@ def _jobs(quick: bool):
             {},
         ),
         (
+            # trace-time planner on the ZeRO train step (ISSUE 20):
+            # stock vs planner-routed compiled step (agreed table
+            # lowers the grad reduce-scatter / weight re-gather as ring
+            # bodies) plus overlap on/off; --force-alg ring keeps the
+            # CPU row's non-stock selection deterministic (TPU probes)
+            "zero_planner_traced",
+            [sys.executable, "benchmarks/zero_bench.py", "--mode",
+             "plan", "--force-alg", "ring"]
+            + (["--quick", "--steps", "3"] if q else ["--steps", "6"]),
+            {"TDX_CPU_DEVICES": "2"},
+        ),
+        (
             "resnet_ddp",
             [sys.executable, "benchmarks/resnet_ddp.py"]
             + (["--steps", "5", "--warmup", "2", "--batch", "32"] if q else []),
@@ -169,6 +181,20 @@ def _jobs(quick: bool):
                 ["--preset", "small", "--steps", "5", "--warmup", "2"]
                 if q
                 else ["--bf16"]
+            ),
+            {},
+        ),
+        (
+            # TP-decode collectives through the traced planner
+            # (ISSUE 20): vocab-logits gather + activation
+            # gather-matmul, stock vs ring lowering, overlap isolated
+            "transformer_tp_decode_planned",
+            [sys.executable, "benchmarks/transformer_lm.py",
+             "--planner", "traced"]
+            + (
+                ["--preset", "small", "--steps", "5", "--batch", "4"]
+                if q
+                else ["--preset", "small", "--steps", "20"]
             ),
             {},
         ),

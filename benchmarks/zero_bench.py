@@ -251,9 +251,100 @@ def run_parity(args, tdx, jax):
     return summary
 
 
+def run_plan(args, tdx, jax):
+    """**zero_planner_traced** (`--mode plan`): the same ZeRO "auto"
+    train step compiled three ways — planner off (stock lowering),
+    planner on (the `plan/traced.py` table routes the step's grad
+    reduce-scatter and weight re-gather through the agreed schedule),
+    and planner on with `TDX_PLANNER_OVERLAP=0` (decomposed gathers
+    pinned back to one-shot; isolates the overlap contribution).  Value
+    is stock/planned step-time speedup; the row also proves the planned
+    step's params match stock within 1e-5 (CPU rows: pass
+    ``--force-alg ring`` so a non-stock schedule is selected
+    deterministically instead of by probe)."""
+    import os
+
+    import optax
+
+    from benchmarks.common import emit, on_tpu, persist_result
+    from pytorch_distributed_example_tpu.plan import traced
+
+    W = tdx.get_world_size()
+    preset = "mem-quick" if args.quick else "mem"
+    model, params, toks, loss_fn = _lm_setup(
+        jax, preset, args.seq, args.batch
+    )
+    opt = optax.adamw(1e-4)
+
+    env_keys = ("TDX_COLLECTIVE_PLANNER", "TDX_PLANNER_FORCE",
+                "TDX_PLANNER_OVERLAP")
+    saved = {k: os.environ.get(k) for k in env_keys}
+
+    def timed(env):
+        for k in env_keys:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        traced.reset()
+        try:
+            p, o, losses, step = _train(
+                tdx, jax, model, params, toks, loss_fn, opt, 1, "auto"
+            )  # warmup: compile + (planner on) probe/agree outside it
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                p, o, loss = step(p, o, toks, toks)
+            jax.block_until_ready(p)
+            dt = (time.perf_counter() - t0) / max(args.steps, 1)
+            return dt, p, traced.lookup(
+                "reduce_scatter",
+                max(a.size * a.dtype.itemsize
+                    for a in jax.tree_util.tree_leaves(p)),
+                "avg",
+            )
+        finally:
+            traced.reset()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    planner_env = {"TDX_COLLECTIVE_PLANNER": "1"}
+    if args.force_alg:
+        planner_env["TDX_PLANNER_FORCE"] = args.force_alg
+
+    t_stock, p_stock, _ = timed({})
+    t_plan, p_plan, entry = timed(planner_env)
+    t_noov, _, _ = timed({**planner_env, "TDX_PLANNER_OVERLAP": "0"})
+
+    rel, bitwise = _worst_rel(jax, p_stock, p_plan)
+    picked = entry["alg"] if entry else "stock"
+    summary = emit(
+        "zero_planner_traced",
+        t_stock / t_plan if t_plan else 0.0,
+        "x_step_time",
+        world=W,
+        preset=preset,
+        steps=args.steps,
+        schedule=picked,
+        schedule_source=(entry or {}).get("source", "none"),
+        forced=args.force_alg or "",
+        stock_s_per_step=round(t_stock, 5),
+        planned_s_per_step=round(t_plan, 5),
+        overlap_off_s_per_step=round(t_noov, 5),
+        overlap_gain_x=round(t_noov / t_plan, 4) if t_plan else 0.0,
+        max_rel_param_diff=rel,
+        bitwise=bitwise,
+        target=1e-5,
+    )
+    if on_tpu():
+        persist_result("zero_planner_traced", summary)
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["mem", "parity"], default="mem")
+    ap.add_argument("--mode", choices=["mem", "parity", "plan"],
+                    default="mem")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--batch", type=int, default=8)
@@ -263,6 +354,12 @@ def main():
         help="per-rank optimizer-state budget for --mode mem (0 = real "
         "HBM on TPU, else 75%% of the unsharded state, labeled "
         "synthetic)",
+    )
+    ap.add_argument(
+        "--force-alg", default="",
+        help="--mode plan: pin the planner's schedule "
+        "(TDX_PLANNER_FORCE) instead of probing — the deterministic "
+        "non-stock CPU row",
     )
     args = ap.parse_args()
     if args.quick:
@@ -282,6 +379,8 @@ def main():
 
     if args.mode == "mem":
         run_mem(args, tdx, jax)
+    elif args.mode == "plan":
+        run_plan(args, tdx, jax)
     else:
         run_parity(args, tdx, jax)
 

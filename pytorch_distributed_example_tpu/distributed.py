@@ -704,6 +704,17 @@ def destroy_process_group(group: Optional[ProcessGroup] = None) -> None:
                     )
         _world = _WorldState()
         GroupMember.WORLD = None
+        # the traced-planner schedule table and agreement sequence are
+        # incarnation-scoped like the pg prefix keys: a new gang after an
+        # elastic restart must re-probe and re-agree (stale entries could
+        # carry a dead world size, and a stale seq would desync the
+        # sequence-keyed planagree rounds)
+        try:
+            from .plan import traced as _traced
+
+            _traced.reset()
+        except Exception:
+            logger.debug("traced planner reset failed", exc_info=True)
     else:
         if group.watchdog is not None:
             group.watchdog.stop()

@@ -78,6 +78,29 @@ def noop_hook(grads, axis_name: str):
     return grads
 
 
+def planner_hook(group=None):
+    """Traced-planner gradient reduction: each leaf's mean-allreduce
+    takes the AGREED schedule for its own size bucket from the
+    `plan/traced.py` table (probe outside the trace, store-agreed
+    before compilation), mixing one-shot pmean for biases with ring/rhd
+    ppermute bodies for the big matmul gradients inside one compiled
+    step. A bucket with no agreed entry warns once and takes the stock
+    pmean — the old trace-time decline path, now loud. ``group``
+    (optional) lets driver-mode dispatch fall back to the group
+    planner's trace-safe cache lookups for unprepared buckets."""
+    from ..plan import traced
+
+    def hook(grads, axis_name: str):
+        return jax.tree_util.tree_map(
+            lambda g: traced.all_reduce(
+                g, axis_name, reduce_kind="avg", group=group
+            ),
+            grads,
+        )
+
+    return hook
+
+
 # ---------------------------------------------------------------------------
 # PowerSGD — low-rank gradient compression with error feedback
 # ---------------------------------------------------------------------------

@@ -800,6 +800,26 @@ def make_ddp_train_step(
             return opt_state
         return zero.from_shard_layout(opt_state, unsharded_tpl)
 
+    _planner_prepared = [False]
+
+    def _maybe_prepare_planner(params):
+        """Probe + agree the step's collective schedules OUTSIDE the
+        trace, once, before the first compile: per-leaf all-reduce
+        buckets for the comm hook plus ZeRO's reduce-scatter/all-gather
+        halves. In a multiproc gang each entry rides a sequence-keyed
+        store agreement round, so a skewed TDX_PLANNER_FORCE fails
+        HERE — at compile time, naming the first divergent eqn — not
+        as a hang in the first collective. Errors propagate: schedule
+        divergence must never be swallowed into a silent fallback."""
+        if _planner_prepared[0]:
+            return
+        _planner_prepared[0] = True
+        from ..plan import active_for_group, traced
+
+        if not active_for_group(g) or W < 2:
+            return
+        traced.prepare_for_params(g, params, zero_update=zero_update)
+
     def _dispatch(params, opt_state, hook_state, x, y, rng):
         nonlocal jitted
         # hot-path: the state threaded back from the previous call is
@@ -810,6 +830,7 @@ def make_ddp_train_step(
             return _finish(jitted(
                 params, opt_state, hook_state, x, y, rng
             ))
+        _maybe_prepare_planner(params)
         if zero_update and _zero_resolved(params):
             try:
                 opt_state = shard_opt_state(params, opt_state)

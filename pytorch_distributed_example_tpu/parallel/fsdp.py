@@ -374,6 +374,22 @@ def make_zero2_train_step(
         tree, jmesh, shd.replicated_specs(tree)
     )
 
+    if comm_hook is None:
+        # planner-aware default: with the traced planner on, the grad
+        # reduction moves into the explicit shard_map region and takes
+        # the agreed schedule table's per-bucket winner
+        # (plan/traced.py — probe outside the trace, prepared below at
+        # first call); planner off keeps the GSPMD implicit reduction
+        # exactly as before
+        from ..plan import traced
+
+        if traced.enabled():
+            present = [a for a in data_axes if a in dict(jmesh.shape)]
+            if len(present) == 1:
+                from . import comm_hooks
+
+                comm_hook = comm_hooks.planner_hook()
+
     hook_axis = None
     if comm_hook is not None:
         if hasattr(comm_hook, "init") and hasattr(comm_hook, "apply"):
@@ -417,6 +433,33 @@ def make_zero2_train_step(
         comm_hook=comm_hook,
         hook_axis=hook_axis,
     )
+
+    if comm_hook is not None and hook_axis is not None:
+        # probe + agree the hook's per-leaf schedule buckets on the
+        # host BEFORE the first call compiles the step (plan/traced.py:
+        # the trace then reads the agreed table purely). Needs a live
+        # process group for the planner/store; without one the dispatch
+        # seam still honors TDX_PLANNER_FORCE and otherwise warns into
+        # the stock lowering.
+        inner_step = step
+        _prepared = [False]
+
+        # distinct name: this host-side wrapper is never jitted (only
+        # ``inner_step`` is), and must not share the jitted function's
+        # qualname or static analysis conflates the two trace roots
+        def _prepared_step(params, opt_state, x, y, *rng):
+            if not _prepared[0]:
+                _prepared[0] = True
+                from .. import distributed as dist
+                from ..plan import traced
+
+                if dist.is_initialized() and traced.enabled():
+                    traced.prepare_for_params(
+                        dist._get_default_group(), params
+                    )
+            return inner_step(params, opt_state, x, y, *rng)
+
+        step = _prepared_step
 
     def init_opt_state(params):
         """State in the step's native layout: dim-0 sharded over

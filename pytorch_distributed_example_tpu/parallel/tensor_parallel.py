@@ -105,12 +105,20 @@ def column_parallel_matmul(x, w_local, axis: str = "tp"):
 
 
 def row_parallel_matmul(x_local, w_local, axis: str = "tp"):
-    """y = psum(x_local @ w_local) inside shard_map; the "g operator"."""
+    """y = psum(x_local @ w_local) inside shard_map; the "g operator".
+
+    The reduction routes through the traced planner seam
+    (`plan/traced.py`): with an agreed/forced schedule for this
+    activation's size bucket the psum lowers as the chosen ring/rhd
+    ppermute body; planner off keeps the stock `lax.psum`."""
     import jax.numpy as jnp
-    from jax import lax
+
+    from ..plan import traced
 
     partial = jnp.dot(x_local, w_local, preferred_element_type=jnp.float32)
-    return lax.psum(partial, axis).astype(x_local.dtype)
+    return traced.all_reduce(
+        partial, axis, reduce_kind="sum", warn_missing=False
+    ).astype(x_local.dtype)
 
 
 def mlp_block_tp(x, w_up_local, w_down_local, axis: str = "tp", act=None):
@@ -126,12 +134,36 @@ def vocab_parallel_logits(h, emb_local, axis: str = "tp"):
     """Vocab-parallel LM head: local logits chunk, all-gathered on last dim.
 
     Prefer `vocab_parallel_cross_entropy` when the logits only feed a
-    loss: it never materializes the (..., V) gather at all."""
+    loss: it never materializes the (..., V) gather at all. The gather
+    routes through the traced planner seam: an agreed ring schedule
+    decomposes it into per-chunk ppermute rounds (bitwise the one-shot
+    gather) that the decode loop's surrounding compute can hide."""
     import jax.numpy as jnp
-    from jax import lax
+
+    from ..plan import traced
 
     local = jnp.dot(h, emb_local, preferred_element_type=jnp.float32)
-    return lax.all_gather(local, axis, axis=local.ndim - 1, tiled=True)
+    return traced.all_gather(
+        local, axis, dim=local.ndim - 1, tiled=True, warn_missing=False
+    )
+
+
+def gathered_matmul(x_local, w, axis: str = "tp"):
+    """y = all_gather(x_local) @ w with the gather overlapped behind the
+    matmul chunks (sequence-sharded activations, replicated weight —
+    the TP decode re-gather shape). With an agreed/forced ring schedule
+    and `TDX_PLANNER_OVERLAP` on, each landed chunk's matmul issues
+    while the next chunk's ppermute is in flight
+    (`plan/traced.all_gather_matmul`); otherwise the stock one-shot
+    gather followed by one matmul. Row-exact either way: every output
+    row contracts the identical chunk in the identical order."""
+    import jax.numpy as jnp
+
+    from ..plan import traced
+
+    return traced.all_gather_matmul(
+        x_local, w, axis, preferred_element_type=jnp.float32
+    ).astype(x_local.dtype)
 
 
 def vocab_parallel_cross_entropy(

@@ -79,11 +79,19 @@ def shard_of(leaf, index, world: int):
 
 def unshard(shard, axis_name: str, shape: Tuple[int, ...], dtype=None):
     """All-gather a (k,) shard back into the full leaf shape — the
-    weight-update side's single collective."""
-    from jax import lax
+    weight-update side's single collective. Routed through the traced
+    planner seam (`plan/traced.py`): with an agreed ring schedule the
+    gather lowers as decomposed ppermute rounds whose per-chunk data
+    movement XLA overlaps with the neighbouring leaves' update math
+    (bitwise the one-shot gather — pure data movement); planner off
+    means the stock `lax.all_gather` exactly as before."""
     import numpy as np
 
-    full = lax.all_gather(shard, axis_name, tiled=True)
+    from ..plan import traced
+
+    full = traced.all_gather(
+        shard, axis_name, dim=0, tiled=True, warn_missing=False
+    )
     size = int(np.prod(shape, dtype=np.int64)) if shape else 1
     out = full[:size].reshape(shape)
     return out.astype(dtype) if dtype is not None else out
@@ -91,13 +99,17 @@ def unshard(shard, axis_name: str, shape: Tuple[int, ...], dtype=None):
 
 def reduce_scatter_mean(leaf, axis_name: str, world: int):
     """Gradient reduction straight to the owning shard: pad-flat, one
-    `psum_scatter`, divide by world — the ZeRO wire shape (the unsharded
+    reduce-scatter, averaged — the ZeRO wire shape (the unsharded
     path's pmean is this plus an all-gather the update no longer
-    needs)."""
-    from jax import lax
+    needs). Routed through the traced planner seam: an agreed ring
+    schedule lowers as the explicit ppermute ring; planner off keeps
+    the stock `psum_scatter / world` bit-for-bit."""
+    from ..plan import traced
 
     flat = padded_flat(leaf, world)
-    return lax.psum_scatter(flat, axis_name, tiled=True) / world
+    return traced.reduce_scatter(
+        flat, axis_name, reduce_kind="avg", warn_missing=False
+    )
 
 
 def to_shard_layout(tree, world: int):

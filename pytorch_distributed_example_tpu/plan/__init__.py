@@ -31,7 +31,7 @@ from typing import Optional
 
 import numpy as np
 
-from . import driver, executor, probe, schedules, topology, transfer
+from . import driver, executor, probe, schedules, topology, traced, transfer
 from .planner import CollectivePlanner
 from .schedules import Plan, Round, Step
 from .topology import Topology
@@ -42,7 +42,8 @@ __all__ = [
     "active_for_group", "enable_for_group", "planner_for_group",
     "maybe_lower", "ddp_comm_hook", "reset_group",
     "schedule_migration", "chunk_spans",
-    "driver", "executor", "probe", "schedules", "topology", "transfer",
+    "driver", "executor", "probe", "schedules", "topology", "traced",
+    "transfer",
 ]
 
 _ENV = "TDX_COLLECTIVE_PLANNER"
@@ -183,37 +184,23 @@ def ddp_comm_hook(group):
         return None
     if not _backend_is_xla(group):
         return None
+    # Both modes route through the traced dispatch seam
+    # (`plan/traced.py`): the per-leaf choice is a PURE trace-time
+    # lookup in the probe-agreed schedule table that
+    # `make_ddp_train_step` prepares on the host before compiling.
+    # Multiproc no longer silently declines — the table was
+    # store-agreed (J005 sequence-keyed rounds) before compilation, so
+    # every rank compiles the identical SPMD program, and a leaf whose
+    # bucket was never prepared warns once and takes the stock pmean.
+    # Driver mode additionally falls back to the group planner's
+    # trace-safe cache lookups for unprepared buckets (`group=` below),
+    # preserving the pre-table behavior.
     from .. import distributed as dist
+    from ..parallel import comm_hooks
 
-    if dist._world.mode == "multiproc":
-        # the hook chooses (and may PROBE) per leaf at trace time from
-        # purely process-local state; in multi-controller mode two hosts
-        # with different probe caches would compile two different SPMD
-        # programs — a silent schedule divergence. The compiled-step
-        # planner is a driver-mode feature; multiproc gradients keep the
-        # stock pmean (the eager dispatch path stays planner-covered
-        # through the store-agreed plane choice).
-        return None
-    pl = planner_for_group(group)
-    W = group.size()
-
-    def hook(grads, axis_name):
-        import jax
-        from jax import lax
-
-        def one(leaf):
-            alg, _ = pl.choose(
-                "all_reduce", int(leaf.size) * leaf.dtype.itemsize, "avg",
-                "driver",
-            )
-            if alg == "onepass":
-                return lax.pmean(leaf, axis_name)
-            body = driver.body_for("all_reduce", alg, W, axis_name, "avg")
-            return body(leaf)
-
-        return jax.tree_util.tree_map(one, grads)
-
-    return hook
+    return comm_hooks.planner_hook(
+        group=group if dist._world.mode != "multiproc" else None
+    )
 
 
 # -- multiproc p2p plane ----------------------------------------------------

@@ -183,8 +183,21 @@ def probe_driver(mesh, axis: str, world: int, op: str,
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
+    from .. import traceguard
     from .._compat import shard_map_fn
     from . import driver
+
+    if traceguard.under_tracing():
+        # the planner-probe bug class (distlint R011): timing compiled
+        # programs is host work — reached from a trace it would bake one
+        # probe run's artifacts into the jaxpr and block the tracer on
+        # device sync. The traced path must prepare() BEFORE compiling.
+        raise traceguard.TraceGuardError(
+            "plan.probe.probe_driver called under tracing: probing runs "
+            "and times compiled host programs; probe outside the trace "
+            "(plan.traced.prepare) and let the trace read the agreed "
+            "table"
+        )
 
     # per-rank f32 payload of the bucket's size, rounded to the chunk
     # granularity every candidate accepts

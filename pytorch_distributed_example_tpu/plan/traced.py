@@ -1,0 +1,458 @@
+"""Trace-time planner dispatch — probe-agreed schedules inside `jit`
+(ROADMAP item 3; PCCL arxiv 2606.07019, "Big Send-off" arxiv
+2504.18658).
+
+The eager planner (`plan/__init__.maybe_lower`) swaps measured
+schedules into `ProcessGroup._dispatch`, but everything compiled — TP
+decode gathers, ZeRO's psum_scatter/all_gather halves, the DDP comm
+hook — took the stock XLA lowering because choosing INSIDE a trace is
+illegal twice over: probing runs compiled programs under the tracer
+(distlint R011, the planner-probe bug class), and a choice made from
+process-local state (per-host probe caches, a skewed
+`TDX_PLANNER_FORCE`) compiles divergent SPMD programs across a
+multiproc gang — a silent hang at first dispatch.
+
+This module makes the choice legal by splitting it in time:
+
+1. **Probe outside the trace** — `prepare()` runs at step-factory /
+   first-dispatch time on the host, keyed
+   `(op, payload-size bucket, reduce kind)` per process, choosing via
+   the group's `CollectivePlanner` (force → cache → probe → structural
+   default).  Calling it under tracing raises `TraceGuardError` — the
+   probe can never run host ops inside a trace.
+2. **Agree before compilation** — in multiproc mode each chosen entry
+   rides a sequence-keyed `schedule.agree_program` round (the proglint
+   J005 discipline, `traced{seq}` keys under a `planagree` store
+   prefix): group rank 0's choice is adopted by unforced ranks, then
+   every rank publishes the schedule's round descriptors and a skewed
+   gang fails AT COMPILE TIME with the first divergent eqn named,
+   instead of hanging in the first collective.
+3. **Dispatch inside the trace** — `all_reduce` / `all_gather` /
+   `reduce_scatter` here are pure trace-time table lookups (no host
+   I/O, R011-clean) that lower the agreed algorithm as
+   `plan/driver.py`'s shard_map ppermute bodies; no agreed entry means
+   the stock lowering (with a one-shot warning when the planner is on
+   — the comm-hook decline path is loud now, never silent).
+
+**Overlap** (`TDX_PLANNER_OVERLAP`, default on): decomposed ring
+schedules expose per-chunk rounds XLA's latency-hiding scheduler can
+interleave with compute — `all_gather_matmul` runs each gathered
+chunk's matmul behind the next chunk's ppermute (TP activation
+gathers), and ZeRO's weight re-gather takes the decomposed ring so its
+rounds overlap the neighbouring leaves' update math.  `=0` pins every
+gather back to the one-shot lowering (A/B seam; `TDX_PLANNER_FORCE`
+and `TDX_COLLECTIVE_PLANNER=0` are honored inside traces the same
+way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import warnings
+from typing import Dict, Iterable, Optional, Tuple
+
+from .. import traceguard
+from . import driver, probe, schedules
+
+__all__ = [
+    "enabled", "overlap_enabled", "reset", "seed", "lookup",
+    "prepare", "prepare_for_params",
+    "all_reduce", "all_gather", "reduce_scatter", "all_gather_matmul",
+    "agree_entry",
+]
+
+_ENV = "TDX_COLLECTIVE_PLANNER"
+_ENV_FORCE = "TDX_PLANNER_FORCE"
+_ENV_OVERLAP = "TDX_PLANNER_OVERLAP"
+_AGREE_PREFIX = "planagree"
+
+# The process-wide agreed schedule table: (op, bucket, reduce_kind) ->
+# {"alg", "world", "source"}.  Filled only by prepare()/seed() on the
+# host; read (pure) by the dispatch functions at trace time.  Reset on
+# process-group teardown (`distributed.destroy_process_group`).
+_TABLE: Dict[Tuple[str, int, str], Dict] = {}
+# Global agreement-round counter — the J005 sequence-key discipline:
+# rounds are keyed by POSITION (`traced{seq}`), not by name, so a rank
+# that prepared a different entry at the same position is diagnosed
+# instead of timing out on a key that never appears.  Advanced only on
+# success: a timed-out round retries under the SAME key (idempotent
+# re-publish), so a rank joining mid-agreement converges cleanly.
+_AGREE_SEQ = [0]
+_WARNED: set = set()
+
+
+def enabled(group=None) -> bool:
+    """Is the traced planner active?  Per-group override wins when a
+    group is supplied (mirrors `plan.active_for_group`)."""
+    if group is not None:
+        from . import active_for_group
+
+        return active_for_group(group)
+    return os.environ.get(_ENV, "0") == "1"
+
+
+def overlap_enabled() -> bool:
+    return os.environ.get(_ENV_OVERLAP, "1") != "0"
+
+
+def reset() -> None:
+    """Drop the agreed table + warning dedup (tests, PG teardown)."""
+    _TABLE.clear()
+    _WARNED.clear()
+    _AGREE_SEQ[0] = 0
+
+
+def seed(op: str, alg: str, *, world: int, nbytes: int,
+         reduce_kind: str = "sum", source: str = "seed") -> None:
+    """Insert one agreed entry directly (tests, lint catalogs, benches
+    with a pre-probed table)."""
+    bucket = probe.bucket_bytes(max(int(nbytes), 1))
+    _TABLE[(op, bucket, reduce_kind)] = {
+        "alg": alg, "world": int(world), "source": source,
+    }
+
+
+def lookup(op: str, nbytes: int, reduce_kind: str = "sum") -> Optional[Dict]:
+    """Pure table lookup by payload size (trace-safe)."""
+    bucket = probe.bucket_bytes(max(int(nbytes), 1))
+    return _TABLE.get((op, bucket, reduce_kind))
+
+
+# ---------------------------------------------------------------------------
+# host side: probe + agree (OUTSIDE any trace)
+# ---------------------------------------------------------------------------
+
+
+def _choose_no_probe(pl, op: str, nbytes: int, reduce_kind: str):
+    """Planner choice with probing suppressed (multiproc prepare: the
+    probe would run collectives unilaterally; force/cache still apply,
+    else the structural default)."""
+    saved = pl._probe_fn
+    pl._probe_fn = lambda *a, **k: None
+    try:
+        return pl.choose(op, nbytes, reduce_kind, "driver")
+    finally:
+        pl._probe_fn = saved
+
+
+def _plan_eqns(pl, op: str, alg: str, world: int, bucket: int,
+               reduce_kind: str):
+    """The ordered round descriptors the agreement round publishes —
+    divergent algorithms differ at round 1, so
+    `ProgramScheduleMismatchError` names eqn #1 with both ranks'
+    schedules spelled out."""
+    if alg == "onepass":
+        return [f"{op}.onepass|{reduce_kind}|stock-lowering|b{bucket}"]
+    base = schedules.EXEC_VARIANTS.get(alg, alg)
+    # deterministic per-rank element count derived from the agreed
+    # bucket: every rank synthesizes the identical plan
+    nelems = max(bucket // 4, world)
+    plan = pl.plan_for(op, base, nelems)
+    return [
+        f"{op}.{alg}|w{world}|{reduce_kind}|round{i}|{rnd.descriptor()}"
+        for i, rnd in enumerate(plan.rounds)
+    ]
+
+
+def agree_entry(store, rank: int, world: int, seq: int, *, op: str,
+                bucket: int, reduce_kind: str, eqns, timeout=None) -> None:
+    """One J005-style agreement round for one table entry: publish this
+    rank's schedule descriptors under the position key `traced{seq}`
+    and compare every peer's.  Raises `ProgramScheduleMismatchError`
+    naming the first divergent eqn on skew; idempotent per (seq,
+    payload), so retrying after a peer's late join republishes the
+    same row and succeeds."""
+    from .. import schedule
+
+    digest = hashlib.sha256(
+        "\n".join([op, str(bucket), reduce_kind, str(world)] + list(eqns))
+        .encode()
+    ).hexdigest()
+    schedule.agree_program(
+        store, rank, world, f"traced{seq}",
+        {
+            "name": f"plan.traced.{op}/b{bucket}/{reduce_kind}",
+            "digest": digest,
+            "eqns": list(eqns),
+        },
+        timeout=timeout,
+    )
+
+
+def prepare(group, entries: Iterable[Tuple[str, int, str]], *,
+            timeout: Optional[float] = None) -> Dict:
+    """Choose + agree schedules for ``entries`` (each
+    ``(op, per_rank_bytes, reduce_kind)``) and install them in the
+    process-wide table.  Host-only: raises `TraceGuardError` under
+    tracing — probing (and the store agreement) are host ops the trace
+    must never reach (distlint R011).  Multiproc gangs must call this
+    collectively (SPMD discipline) with identical entries; a skewed
+    `TDX_PLANNER_FORCE` fails here, at compile time, naming the first
+    divergent eqn."""
+    if traceguard.under_tracing():
+        raise traceguard.TraceGuardError(
+            "plan.traced.prepare called under tracing: the schedule "
+            "probe runs compiled host programs and store agreement "
+            "rounds — host ops that must complete BEFORE the trace "
+            "(call prepare() at step-factory time, then dispatch reads "
+            "the agreed table purely)"
+        )
+    from .. import distributed as dist
+    from . import planner_for_group
+
+    W = group.size()
+    if W < 2:
+        return {}
+    multiproc = dist._world.mode == "multiproc"
+    pl = planner_for_group(group)
+    rank = group.rank()
+    store = group.store if multiproc else None
+    agreed: Dict = {}
+    forced = os.environ.get(_ENV_FORCE)
+    for op, nbytes, reduce_kind in entries:
+        bucket = probe.bucket_bytes(max(int(nbytes), 1))
+        tkey = (op, bucket, reduce_kind)
+        hit = _TABLE.get(tkey)
+        if hit is not None and hit["world"] == W:
+            agreed[tkey] = hit["alg"]
+            continue
+        if multiproc:
+            alg, source = _choose_no_probe(pl, op, nbytes, reduce_kind)
+        else:
+            alg, source = pl.choose(op, nbytes, reduce_kind, "driver")
+        if store is not None and W > 1:
+            # rank 0's choice is adopted by unforced ranks (per-host
+            # probe caches may disagree; frame of reference is rank 0,
+            # as on the eager p2p plane) — a LOCAL force is operator
+            # intent and is kept, so skew is diagnosed, not laundered
+            key = f"tracedalg/{op}/{bucket}/{reduce_kind}"
+            if rank == 0:
+                store.set(key, f"{alg}".encode())  # storelint: disable=S005 -- one row per (op,bucket,kind) for the life of the incarnation-scoped store; reclaimed with it
+            else:
+                store.wait([key], group.timeout)
+                published = store.get(key).decode()
+                if not forced:
+                    alg, source = published, "agreed"
+            eqns = _plan_eqns(pl, op, alg, W, bucket, reduce_kind)
+            from ..store import PrefixStore
+
+            agree_entry(
+                PrefixStore(_AGREE_PREFIX, store), rank, W,
+                _AGREE_SEQ[0], op=op, bucket=bucket,
+                reduce_kind=reduce_kind, eqns=eqns, timeout=timeout,
+            )
+            # advance only after success: a timed-out round (peer
+            # joining mid-agreement) retries under the same key
+            _AGREE_SEQ[0] += 1
+        _TABLE[tkey] = {"alg": alg, "world": W, "source": source}
+        agreed[tkey] = alg
+    return agreed
+
+
+def prepare_for_params(group, params, *, zero_update: bool = False,
+                       timeout: Optional[float] = None) -> Dict:
+    """Derive the DDP/ZeRO step's bucket set from a param tree and
+    prepare it: per-leaf all_reduce(avg) for the hook path, plus the
+    reduce_scatter/all_gather halves of the sharded weight update."""
+    import jax
+
+    W = group.size()
+    entries = []
+    seen = set()
+    for leaf in jax.tree_util.tree_leaves(params):
+        if getattr(leaf, "ndim", 0) < 1:
+            continue
+        nbytes = int(leaf.size) * leaf.dtype.itemsize
+        for op, per_rank in (
+            ("all_reduce", nbytes),
+            *(
+                (
+                    ("reduce_scatter", nbytes),
+                    ("all_gather", max(nbytes // W, 1)),
+                )
+                if zero_update
+                else ()
+            ),
+        ):
+            kind = "avg" if op in ("all_reduce", "reduce_scatter") else "sum"
+            b = probe.bucket_bytes(max(per_rank, 1))
+            if (op, b, kind) in seen:
+                continue
+            seen.add((op, b, kind))
+            entries.append((op, per_rank, kind))
+    return prepare(group, entries, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# trace side: pure dispatch (inside shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def _axis_size(axis_name: str) -> int:
+    from jax import lax
+
+    # psum of a python literal constant-folds to the static axis size
+    return int(lax.psum(1, axis_name))
+
+
+def _choose_traced(op: str, nbytes: int, reduce_kind: str, world: int,
+                   group=None, warn_missing: bool = True) -> Optional[str]:
+    """The trace-time choice ladder: force env → agreed table → the
+    group planner's (trace-safe) cache lookup → stock (None), warning
+    once per (op, bucket) when the planner is on but nothing was
+    agreed.  Pure host-side python over static shape info — no store
+    ops, no probes, R011-clean."""
+    on = enabled(group)
+    forced = os.environ.get(_ENV_FORCE) if on else None
+    if forced and driver.supports(op, forced, world, reduce_kind):
+        return forced
+    entry = lookup(op, nbytes, reduce_kind)
+    if entry is not None and entry["world"] == world:
+        alg = schedules.EXEC_VARIANTS.get(entry["alg"], entry["alg"])
+        if driver.supports(op, alg, world, reduce_kind):
+            return alg
+    if group is not None and on:
+        from .. import distributed as dist
+
+        if dist._world.mode != "multiproc":
+            # driver (single-controller) mode: consult the group's
+            # planner only if one was already built on the host (by
+            # prepare() or an eager dispatch) — constructing it here
+            # would run topology detection under the trace.  choose()
+            # itself is trace-safe: cache hits return the measured
+            # winner, cache misses the structural default WITHOUT
+            # probing (planner.py guards on trace_state_clean).
+            pl = getattr(group, "_collective_planner", None)
+            if pl is not None:
+                alg, _src = pl.choose(op, nbytes, reduce_kind, "driver")
+                return alg if alg != "onepass" else None
+    if on and warn_missing and entry is None:
+        bucket = probe.bucket_bytes(max(int(nbytes), 1))
+        wkey = (op, bucket, reduce_kind)
+        if wkey not in _WARNED:
+            _WARNED.add(wkey)
+            warnings.warn(
+                f"plan.traced: no agreed schedule for {op} bucket "
+                f"{bucket}B ({reduce_kind}) — taking the stock lowering. "
+                "Call plan.traced.prepare() (or prepare_for_params()) "
+                "on the host before compiling this step to probe and "
+                "agree a schedule for this shape bucket.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+    return None
+
+
+def all_reduce(x, axis_name: str, *, reduce_kind: str = "sum",
+               group=None, warn_missing: bool = True):
+    """In-trace all-reduce through the agreed schedule table; stock
+    psum/pmean/pmax/pmin when nothing is agreed."""
+    from jax import lax
+
+    W = _axis_size(axis_name)
+    alg = (
+        _choose_traced("all_reduce", x.nbytes, reduce_kind, W, group,
+                       warn_missing)
+        if W > 1
+        else None
+    )
+    if alg in (None, "onepass"):
+        red = {
+            "sum": lax.psum, "avg": lax.pmean,
+            "max": lax.pmax, "min": lax.pmin,
+        }[reduce_kind]
+        return red(x, axis_name)
+    return driver.body_for("all_reduce", alg, W, axis_name, reduce_kind)(x)
+
+
+def all_gather(x, axis_name: str, *, dim: int = 0, tiled: bool = True,
+               group=None, warn_missing: bool = True):
+    """In-trace all-gather; a ring choice lowers to the decomposed W-1
+    ppermute rounds (the overlap vehicle — pure data movement, bitwise
+    the one-shot gather) unless `TDX_PLANNER_OVERLAP=0` pins the
+    one-shot lowering back."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = _axis_size(axis_name)
+    alg = (
+        _choose_traced("all_gather", x.nbytes, "sum", W, group,
+                       warn_missing)
+        if W > 1
+        else None
+    )
+    if alg == "ring" and overlap_enabled():
+        chunks = driver.body_for("all_gather", "ring", W, axis_name)(
+            x[None]
+        )[0]  # (W, *x.shape), rank-ordered
+        parts = tuple(chunks[i] for i in range(W))
+        if tiled:
+            return jnp.concatenate(parts, axis=dim)
+        return jnp.stack(parts, axis=dim)
+    return lax.all_gather(x, axis_name, axis=dim, tiled=tiled)  # distlint: disable=R004 -- axis_name routes this in-trace collective; ``group`` only scopes the planner table lookup
+
+
+def reduce_scatter(flat, axis_name: str, *, reduce_kind: str = "sum",
+                   group=None, warn_missing: bool = True):
+    """In-trace reduce-scatter of a flat ``(W*k,)`` payload to this
+    rank's ``(k,)`` chunk (the ZeRO grad-reduction wire shape)."""
+    from jax import lax
+
+    W = _axis_size(axis_name)
+    alg = (
+        _choose_traced("reduce_scatter", flat.nbytes, reduce_kind, W,
+                       group, warn_missing)
+        if W > 1
+        else None
+    )
+    if alg not in (None, "onepass"):
+        return driver.body_for(
+            "reduce_scatter", alg, W, axis_name, reduce_kind
+        )(flat.reshape(1, W, -1))[0]
+    out = lax.psum_scatter(flat, axis_name, tiled=True)
+    return out / W if reduce_kind == "avg" else out
+
+
+def all_gather_matmul(x_local, w, axis_name: str, *, group=None,
+                      preferred_element_type=None):
+    """``all_gather(x_local, dim=0, tiled=True) @ w`` with the gather
+    decomposed into ring rounds and each landed chunk's matmul issued
+    immediately — chunk k's compute hides chunk k+1's ppermute (the
+    PCCL overlapped collective-matmul).  CHUNK-exact: the result is
+    bitwise the concatenation of per-chunk ``x_chunk @ w`` dots (chunk
+    values and ordering identical to the gathered layout).  Vs the
+    one-shot gather-then-matmul it is allclose, not necessarily
+    bitwise — XLA tiles a ``(W*m, k)`` and an ``(m, k)`` contraction
+    differently at hardware matmul precision, reassociating the
+    within-row sum.  Falls back to the one-shot gather
+    when the planner declines, the world is trivial, or
+    `TDX_PLANNER_OVERLAP=0`."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = _axis_size(axis_name)
+    alg = (
+        _choose_traced("all_gather", x_local.nbytes, "sum", W, group,
+                       warn_missing=False)
+        if W > 1
+        else None
+    )
+    if W < 2 or alg != "ring" or not overlap_enabled():
+        full = lax.all_gather(x_local, axis_name, axis=0, tiled=True)  # distlint: disable=R004 -- axis_name routes this in-trace collective; ``group`` only scopes the planner table lookup
+        return jnp.dot(
+            full, w, preferred_element_type=preferred_element_type
+        )
+    idx = lax.axis_index(axis_name)
+    pairs = [(i, (i + 1) % W) for i in range(W)]
+    m = x_local.shape[0]
+    y0 = jnp.dot(x_local, w, preferred_element_type=preferred_element_type)
+    out = jnp.zeros((W,) + y0.shape, y0.dtype)
+    out = lax.dynamic_update_slice(out, y0[None], (idx,) + (0,) * y0.ndim)
+    cur = x_local
+    for s in range(W - 1):
+        cur = lax.ppermute(cur, axis_name, pairs)
+        y = jnp.dot(cur, w, preferred_element_type=preferred_element_type)
+        b = (idx - s - 1) % W
+        out = lax.dynamic_update_slice(out, y[None], (b,) + (0,) * y.ndim)
+    return out.reshape((W * m,) + y0.shape[1:])

@@ -1253,36 +1253,100 @@ def _run_zero_update(geom: Dict[str, Any], rs_impl=None) -> Dict[str, Any]:
 
 
 def _run_planned_allreduce(geom: Dict[str, Any]) -> Dict[str, Any]:
+    """world x algorithm x lowering-mode parity for planned all-reduce.
+
+    ``mode``:
+      - ``eager``        — the eager planner's `driver.compiled_body`
+        (the original subject);
+      - ``traced``       — the in-jit dispatch seam (`plan/traced.py`)
+        reading a seeded agreed-table entry, the lowering TP/FSDP/ZeRO
+        call sites emit after `prepare()`;
+      - ``traced_force`` — the same seam driven by `TDX_PLANNER_FORCE`
+        honored inside the trace (empty table).
+
+    Traced modes must be BITWISE the eager compiled body for the same
+    algorithm (both lower the identical `driver.body_for` rounds); a
+    mismatch is bisected to the first divergent jaxpr eqn.  All modes
+    keep the original contracts: ranks bitwise-agree with each other,
+    and sit inside the 1e-5 envelope of the exact f32 sum."""
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh
+    from jax.sharding import Mesh, PartitionSpec as P
 
-    from ..plan import driver
+    from .._compat import shard_map_fn
+    from ..plan import driver, traced
 
     world, alg = geom["world"], geom["schedule"]
+    mode = geom.get("mode", "eager")
     mesh = Mesh(np.array(jax.devices()[:world]), ("r",))
-    prog = driver.compiled_body("all_reduce", alg, world, "r", mesh)
+    eager_prog = driver.compiled_body("all_reduce", alg, world, "r", mesh)
     x = _det_array(world * 64).reshape(world, 64)
-    out = np.asarray(prog(x))
-    exact = np.asarray(jnp.sum(x, axis=0, dtype=jnp.float32))
-    # determinism: every rank must hold bit-identical results
-    rows_agree = all(
-        out[r].tobytes() == out[0].tobytes() for r in range(world)
-    )
-    env_ok = bool(
-        np.allclose(out[0], exact, rtol=1e-5, atol=1e-5)
-    )
-    ok = rows_agree and env_ok
-    detail = ""
-    if not rows_agree:
-        detail = "ranks disagree bitwise on the all-reduce result"
-    elif not env_ok:
-        detail = (
-            f"envelope violated: max |delta| = "
-            f"{float(np.max(np.abs(out[0] - exact))):.3g}"
+
+    env_keys = ("TDX_COLLECTIVE_PLANNER", "TDX_PLANNER_FORCE",
+                "TDX_PLANNER_OVERLAP")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    try:
+        if mode == "eager":
+            sub_fn = eager_prog
+        else:
+            if mode == "traced_force":
+                # force env honored inside the trace; table left empty
+                traced.reset()
+                os.environ["TDX_COLLECTIVE_PLANNER"] = "1"
+                os.environ["TDX_PLANNER_FORCE"] = alg
+            else:
+                # the prepare()-agreed table path, planner env neutral
+                traced.reset()
+                os.environ.pop("TDX_PLANNER_FORCE", None)
+                traced.seed(
+                    "all_reduce", alg, world=world, nbytes=64 * 4,
+                    source="numlint-sweep",
+                )
+            sub_fn = jax.jit(shard_map_fn(
+                lambda t: traced.all_reduce(t, "r", reduce_kind="sum"),
+                mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+            ))
+        out = np.asarray(sub_fn(x))
+        exact = np.asarray(jnp.sum(x, axis=0, dtype=jnp.float32))
+        # determinism: every rank must hold bit-identical results
+        rows_agree = all(
+            out[r].tobytes() == out[0].tobytes() for r in range(world)
         )
-    return {"ok": ok, "detail": detail, "hash": _tree_hash(out)}
+        env_ok = bool(
+            np.allclose(out[0], exact, rtol=1e-5, atol=1e-5)
+        )
+        detail = ""
+        traced_ok = True
+        if mode != "eager":
+            ref = np.asarray(eager_prog(x))
+            traced_ok = out.tobytes() == ref.tobytes()
+            if not traced_ok:
+                detail = (
+                    f"traced lowering diverges bitwise from the eager "
+                    f"compiled body for schedule '{alg}'; "
+                    + first_divergence(sub_fn, eager_prog, (x,))
+                    + f"; max output |delta| = "
+                    f"{float(np.max(np.abs(out - ref))):.3g}"
+                )
+        ok = rows_agree and env_ok and traced_ok
+        if not detail:
+            if not rows_agree:
+                detail = "ranks disagree bitwise on the all-reduce result"
+            elif not env_ok:
+                detail = (
+                    f"envelope violated: max |delta| = "
+                    f"{float(np.max(np.abs(out[0] - exact))):.3g}"
+                )
+        return {"ok": ok, "detail": detail, "hash": _tree_hash(out)}
+    finally:
+        if mode != "eager":
+            traced.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _run_codec_roundtrip(geom: Dict[str, Any]) -> Dict[str, Any]:
@@ -1378,6 +1442,11 @@ def _geoms_zero(quick: bool) -> List[Dict[str, Any]]:
 
 
 def _geoms_plan(quick: bool) -> List[Dict[str, Any]]:
+    # world x algorithm x TDX_PLANNER_FORCE x eager/traced lowering:
+    # modes innermost, traced seam first, so the two-geometry quick
+    # slice covers agreed-table + force-env dispatch on the smallest
+    # geometry (each traced run rebuilds and compares against the
+    # eager program anyway, so eager coverage rides along)
     from ..plan import driver
 
     forced = os.environ.get("TDX_PLANNER_FORCE")
@@ -1388,7 +1457,10 @@ def _geoms_plan(quick: bool) -> List[Dict[str, Any]]:
                 continue
             if not driver.supports("all_reduce", alg, world):
                 continue
-            out.append({"world": world, "schedule": alg})
+            for mode in ("traced", "traced_force", "eager"):
+                out.append(
+                    {"world": world, "schedule": alg, "mode": mode}
+                )
     return out[:2] if quick else out
 
 

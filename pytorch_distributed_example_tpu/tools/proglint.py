@@ -1016,6 +1016,122 @@ def _plan_programs(group) -> List[Tuple[ProgramFingerprint, ProgramMeta]]:
     return out
 
 
+def _traced_programs(group) -> List[Tuple[ProgramFingerprint, ProgramMeta]]:
+    """Fingerprint the trace-time planner dispatch seam
+    (`plan/traced.py`) with a seeded schedule table — the lowered
+    bodies TP/FSDP/ZeRO call sites emit once `prepare()` has agreed a
+    non-stock schedule.  Each registered artifact's `expected_perms`
+    pins the J002 consistency contract: the traced lowering's ppermute
+    sequence must match the plan the agreement round published."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map_fn
+    from ..backends.xla import AXIS
+    from ..plan import driver as plan_driver
+    from ..plan import schedules, topology, traced
+
+    W = group.size()
+    mesh = group.mesh.jax_mesh
+    path = f"{_PKG}/plan/traced.py"
+    topo = topology.Topology(W, (tuple(range(W)),), "cpu")
+    n, m, k, p = 8, 2, 4, 3
+    ring_rounds = tuple(
+        tuple(sorted((i, (i + 1) % W) for i in range(W)))
+        for _ in range(W - 1)
+    )
+
+    def _expected(op, alg):
+        if op == "all_reduce" and alg == "ring":
+            return ()  # psum_scatter + all_gather body: no ppermutes
+        return expected_perms_from_plan(
+            schedules.synthesize(op, alg, W, n, topo)
+        )
+
+    cases = [
+        (
+            "all_reduce", alg,
+            lambda t: traced.all_reduce(t, AXIS, reduce_kind="sum"),  # distlint: disable=R004 -- seeded-table catalog body: axis routes it, no group dispatch under test
+            np.zeros((W, n), np.float32), P(AXIS), _expected("all_reduce", alg),
+        )
+        for alg in ("ring", "rhd")
+        if plan_driver.supports("all_reduce", alg, W, "sum")
+    ]
+    cases.append((
+        "all_gather", "ring",
+        lambda t: traced.all_gather(t[0], AXIS, dim=0, tiled=True)[None],  # distlint: disable=R004 -- seeded-table catalog body: axis routes it, no group dispatch under test
+        np.zeros((W, n), np.float32), P(AXIS), _expected("all_gather", "ring"),
+    ))
+    cases.append((
+        "reduce_scatter", "ring",
+        lambda t: traced.reduce_scatter(t[0], AXIS, reduce_kind="sum")[None],  # distlint: disable=R004 -- seeded-table catalog body: axis routes it, no group dispatch under test
+        np.zeros((W, W * n), np.float32), P(AXIS),
+        _expected("reduce_scatter", "ring"),
+    ))
+
+    env_keys = ("TDX_COLLECTIVE_PLANNER", "TDX_PLANNER_FORCE",
+                "TDX_PLANNER_OVERLAP")
+    saved_env = {key: os.environ.get(key) for key in env_keys}
+    out = []
+    try:
+        # pin the dispatch ladder to the seeded table: planner env off
+        # (no force/planner fallbacks), overlap on (decomposed gathers)
+        os.environ["TDX_COLLECTIVE_PLANNER"] = "0"
+        os.environ.pop("TDX_PLANNER_FORCE", None)
+        os.environ["TDX_PLANNER_OVERLAP"] = "1"
+        for op_name, alg, body, x, spec, expected in cases:
+            traced.reset()
+            traced.seed(
+                op_name, alg, world=W,
+                nbytes=(x.size // W) * x.dtype.itemsize,
+                source="proglint",
+            )
+            prog = jax.jit(shard_map_fn(
+                body, mesh=mesh, in_specs=spec, out_specs=P(AXIS)
+            ))
+            fp = fingerprint_program(
+                f"plan.traced.{op_name}.{alg}",
+                prog,
+                (x,),
+                path=path,
+                mesh_axes=tuple(mesh.axis_names),
+                world=W,
+            )
+            out.append((fp, ProgramMeta(expected_perms=tuple(expected))))
+
+        # the overlapped collective-matmul: its own ppermute loop (one
+        # ring hop per round, own chunk's matmul issued first)
+        traced.reset()
+        xg = np.zeros((W, m, k), np.float32)
+        wmat = np.zeros((k, p), np.float32)
+        traced.seed(
+            "all_gather", "ring", world=W,
+            nbytes=m * k * 4, source="proglint",
+        )
+        prog = jax.jit(shard_map_fn(
+            lambda t, wm: traced.all_gather_matmul(t[0], wm, AXIS)[None],  # distlint: disable=R004 -- seeded-table catalog body: axis routes it, no group dispatch under test
+            mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS),
+        ))
+        fp = fingerprint_program(
+            "plan.traced.all_gather_matmul.ring",
+            prog,
+            (xg, wmat),
+            path=path,
+            mesh_axes=tuple(mesh.axis_names),
+            world=W,
+        )
+        out.append((fp, ProgramMeta(expected_perms=ring_rounds)))
+    finally:
+        traced.reset()
+        for key, val in saved_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    return out
+
+
 def _quant_programs(group) -> List[Tuple[ProgramFingerprint, ProgramMeta]]:
     import jax
     import numpy as np
@@ -1070,6 +1186,7 @@ def build_repo_programs() -> List[Tuple[ProgramFingerprint, ProgramMeta]]:
     out.extend(_serve_programs())
     out.extend(_ddp_programs(group))
     out.extend(_plan_programs(group))
+    out.extend(_traced_programs(group))
     out.extend(_quant_programs(group))
     return out
 

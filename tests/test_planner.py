@@ -673,19 +673,52 @@ class TestDDPCommHook:
     def test_hook_none_when_inactive(self, world):
         assert plan.ddp_comm_hook(world) is None
 
-    def test_hook_declines_in_multiproc_mode(self, world, monkeypatch):
-        """The in-jit hook chooses from process-LOCAL probe state; in
-        multi-controller mode that could compile divergent SPMD programs
-        across hosts — it must decline there (gradients keep pmean; the
-        eager dispatch path stays covered via the store-agreed choice)."""
+    def test_hook_routes_seam_in_multiproc_mode(self, world, monkeypatch):
+        """Multi-controller mode no longer silently declines the in-jit
+        hook: it routes through the `plan/traced.py` seam with
+        group=None, so only store-AGREED table entries (identical
+        across ranks by construction — `traced.prepare` fails on skew)
+        or an explicit force select a schedule, and a bucket nothing
+        agreed on warns once into the stock pmean (the old trace-time
+        decline path, now loud)."""
+        import warnings
+
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from pytorch_distributed_example_tpu._compat import shard_map_fn
+        from pytorch_distributed_example_tpu.backends.xla import AXIS
+        from pytorch_distributed_example_tpu.plan import traced
+
         plan.enable_for_group(world, True)
+        monkeypatch.setenv("TDX_COLLECTIVE_PLANNER", "1")
+        monkeypatch.delenv("TDX_PLANNER_FORCE", raising=False)
+        traced.reset()
         try:
             assert plan.ddp_comm_hook(world) is not None
             monkeypatch.setattr(
                 tdx.distributed._world, "mode", "multiproc"
             )
-            assert plan.ddp_comm_hook(world) is None
+            hook = plan.ddp_comm_hook(world)
+            assert hook is not None
+            W = world.size()
+            mesh = Mesh(np.array(jax.devices()[:W]), (AXIS,))
+            x = np.arange(W * 4, dtype=np.float32).reshape(W, 4)
+            fn = jax.jit(shard_map_fn(
+                lambda t: hook({"g": t}, AXIS)["g"],
+                mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+            ))
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                out = np.asarray(fn(x))
+            assert any(
+                "no agreed schedule" in str(w.message) for w in rec
+            ), [str(w.message) for w in rec]
+            np.testing.assert_allclose(
+                out, np.broadcast_to(x.mean(axis=0), x.shape), rtol=1e-6
+            )
         finally:
+            traced.reset()
             plan.enable_for_group(world, None)
             plan.reset_group(world)
 

@@ -1,0 +1,458 @@
+"""Trace-time planner dispatch (`plan/traced.py`) — ISSUE 20.
+
+The three-beat contract under test:
+
+1. probe OUTSIDE the trace — `prepare()`/`probe_driver` under tracing
+   raise `TraceGuardError` (the distlint R011 planner-probe bug class,
+   now a runtime guarantee);
+2. agree BEFORE compilation — skewed `TDX_PLANNER_FORCE` across a gang
+   fails the sequence-keyed agreement round at compile time naming the
+   first divergent eqn, and a rank joining mid-agreement retries
+   cleanly under the same position key;
+3. dispatch INSIDE the trace is pure — seeded/forced schedules lower
+   as `driver.body_for` ppermute bodies, bitwise (gathers) or
+   envelope-equal (reductions) vs the stock lowering, with
+   `TDX_PLANNER_OVERLAP` pinning gathers between decomposed and
+   one-shot forms.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu import traceguard
+from pytorch_distributed_example_tpu._compat import shard_map_fn
+from pytorch_distributed_example_tpu.backends.xla import AXIS
+from pytorch_distributed_example_tpu.plan import traced
+from pytorch_distributed_example_tpu.schedule import (
+    ProgramScheduleMismatchError,
+)
+from pytorch_distributed_example_tpu.store import HashStore, PrefixStore
+from tests._mp_util import REPO, free_port
+
+
+@pytest.fixture(autouse=True)
+def _isolated_planner(tmp_path, monkeypatch):
+    """Fresh agreed table + neutral planner env for every test."""
+    monkeypatch.setenv(
+        "TDX_PLANNER_PROBE_CACHE", str(tmp_path / "probe_cache.json")
+    )
+    monkeypatch.delenv("TDX_PLANNER_FORCE", raising=False)
+    monkeypatch.delenv("TDX_COLLECTIVE_PLANNER", raising=False)
+    monkeypatch.delenv("TDX_PLANNER_OVERLAP", raising=False)
+    traced.reset()
+    yield
+    traced.reset()
+
+
+def _mesh(world):
+    return jax.sharding.Mesh(np.array(jax.devices()[: world.size()]),
+                             (AXIS,))
+
+
+def _sharded(world, body, in_specs=None):
+    mesh = _mesh(world)
+    if in_specs is None:
+        in_specs = P(AXIS)
+    return jax.jit(
+        shard_map_fn(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=P(AXIS))
+    )
+
+
+class TestTracedDispatch:
+    """Seeded-table lowering inside jit: parity vs stock, algorithm
+    actually honored (the ppermute body is in the jaxpr)."""
+
+    def test_seeded_ring_allreduce_matches_stock_bitwise(self, world):
+        W = world.size()
+        x = np.arange(W * 16, dtype=np.float32).reshape(W, 16)
+        body = lambda t: traced.all_reduce(t, AXIS, reduce_kind="sum")  # noqa: E731
+        stock = np.asarray(_sharded(world, body)(x))
+        traced.seed("all_reduce", "ring", world=W, nbytes=16 * 4)
+        planned = np.asarray(_sharded(world, body)(x))
+        # ring = psum_scatter + all_gather: same pairwise order as the
+        # stock psum on CPU — and every rank must agree bitwise
+        assert all(
+            planned[r].tobytes() == planned[0].tobytes() for r in range(W)
+        )
+        np.testing.assert_allclose(planned, stock, rtol=1e-5, atol=1e-5)
+
+    def test_force_env_honored_inside_trace(self, world, monkeypatch):
+        W = world.size()
+        monkeypatch.setenv("TDX_COLLECTIVE_PLANNER", "1")
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "rhd")
+        x = np.arange(W * 16, dtype=np.float32).reshape(W, 16)
+        fn = _sharded(
+            world, lambda t: traced.all_reduce(t, AXIS, reduce_kind="sum")
+        )
+        txt = str(jax.make_jaxpr(fn)(x))
+        assert "ppermute" in txt  # rhd body, not the stock psum
+        out = np.asarray(fn(x))
+        exact = x.sum(axis=0)
+        np.testing.assert_allclose(out[0], exact, rtol=1e-5, atol=1e-5)
+
+    def test_all_gather_ring_bitwise_and_overlap_flag(
+        self, world, monkeypatch
+    ):
+        W = world.size()
+        x = np.arange(W * 8, dtype=np.float32).reshape(W, 8)
+        body = lambda t: traced.all_gather(  # noqa: E731
+            t[0], AXIS, dim=0, tiled=True
+        )[None]
+        stock = np.asarray(_sharded(world, body)(x))
+        traced.seed("all_gather", "ring", world=W, nbytes=8 * 4)
+        ring_fn = _sharded(world, body)
+        assert "ppermute" in str(jax.make_jaxpr(ring_fn)(x))
+        ring = np.asarray(ring_fn(x))
+        # pure data movement: the decomposed gather is BITWISE the
+        # one-shot gather
+        assert ring.tobytes() == stock.tobytes()
+        # TDX_PLANNER_OVERLAP=0 pins the one-shot lowering back
+        monkeypatch.setenv("TDX_PLANNER_OVERLAP", "0")
+        pinned_fn = _sharded(world, body)
+        assert "ppermute" not in str(jax.make_jaxpr(pinned_fn)(x))
+        assert np.asarray(pinned_fn(x)).tobytes() == stock.tobytes()
+
+    def test_reduce_scatter_ring_parity(self, world):
+        W = world.size()
+        x = np.arange(W * W * 8, dtype=np.float32).reshape(W, W * 8)
+        body = lambda t: traced.reduce_scatter(  # noqa: E731
+            t[0], AXIS, reduce_kind="avg"
+        )[None]
+        stock = np.asarray(_sharded(world, body)(x))
+        traced.seed(
+            "reduce_scatter", "ring", world=W, nbytes=W * 8 * 4,
+            reduce_kind="avg",
+        )
+        ring_fn = _sharded(world, body)
+        assert "ppermute" in str(jax.make_jaxpr(ring_fn)(x))
+        np.testing.assert_allclose(
+            np.asarray(ring_fn(x)), stock, rtol=1e-5, atol=1e-5
+        )
+
+    def test_all_gather_matmul_overlapped_is_row_exact(self, world):
+        W = world.size()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((W, 2, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 3)).astype(np.float32)
+        body = lambda t, wm: traced.all_gather_matmul(  # noqa: E731
+            t[0], wm, AXIS
+        )[None]
+        stock = np.asarray(
+            _sharded(world, body, in_specs=(P(AXIS), P()))(x, w)
+        )
+        traced.seed("all_gather", "ring", world=W, nbytes=2 * 4 * 4)
+        over_fn = _sharded(world, body, in_specs=(P(AXIS), P()))
+        assert "ppermute" in str(jax.make_jaxpr(over_fn)(x, w))
+        over = np.asarray(over_fn(x, w))
+        # chunk-exact: bitwise the concatenation of per-chunk dots
+        ref = np.concatenate(
+            [np.asarray(jnp.dot(jnp.asarray(x[i]), jnp.asarray(w)))
+             for i in range(W)]
+        )
+        assert over[0].tobytes() == ref.tobytes()
+        # vs the one-shot gather+dot: exact here only because conftest
+        # pins jax_default_matmul_precision="highest" (shape-dependent
+        # tiling reassociates the within-row sum at hardware precision)
+        np.testing.assert_allclose(over, stock, rtol=1e-6, atol=1e-6)
+
+    def test_missing_bucket_warns_once_when_planner_on(
+        self, world, monkeypatch
+    ):
+        monkeypatch.setenv("TDX_COLLECTIVE_PLANNER", "1")
+        W = world.size()
+        x = np.zeros((W, 16), np.float32)
+        body = lambda t: traced.all_reduce(t, AXIS, reduce_kind="sum")  # noqa: E731
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            jax.make_jaxpr(_sharded(world, body))(x)
+            jax.make_jaxpr(_sharded(world, body))(x)  # dedup: once only
+        hits = [
+            w for w in rec
+            if issubclass(w.category, RuntimeWarning)
+            and "no agreed schedule" in str(w.message)
+        ]
+        assert len(hits) == 1
+        assert "prepare" in str(hits[0].message)
+
+    def test_planner_off_emits_stock_lowering(self, world):
+        # no table, no envs: the seam must be invisible — stock psum,
+        # stock all_gather, no ppermutes anywhere
+        W = world.size()
+        x = np.zeros((W, 16), np.float32)
+        fn = _sharded(
+            world, lambda t: traced.all_reduce(t, AXIS, reduce_kind="sum")
+        )
+        assert "ppermute" not in str(jax.make_jaxpr(fn)(x))
+
+
+class TestProbeNeverUnderTrace:
+    """distlint R011 as a runtime guarantee (regression: the probe ran
+    host ops under tracing before the guard)."""
+
+    def test_prepare_raises_under_tracing(self, world):
+        # runtime indirection: the call IS the R011 violation under
+        # test — resolved at runtime so the static analyzer does not
+        # chain this deliberate trace root through the library
+        prepare = getattr(traced, "prepare")
+
+        def body(t):
+            with pytest.raises(traceguard.TraceGuardError,
+                               match="prepare called under tracing"):
+                prepare(world, [("all_reduce", 64, "sum")])
+            return t
+
+        jax.make_jaxpr(body)(np.zeros((4,), np.float32))
+
+    def test_probe_driver_raises_under_tracing(self, world):
+        from pytorch_distributed_example_tpu.plan import probe
+
+        mesh = _mesh(world)
+        # runtime indirection, same rationale as prepare above
+        probe_driver = getattr(probe, "probe_driver")
+
+        def body(t):
+            with pytest.raises(traceguard.TraceGuardError,
+                               match="under tracing"):
+                probe_driver(
+                    mesh, AXIS, world.size(), "all_reduce", ("ring",),
+                    1024,
+                )
+            return t
+
+        jax.make_jaxpr(body)(np.zeros((4,), np.float32))
+
+    def test_prepare_on_host_fills_table(self, world, monkeypatch):
+        # driver mode, forced: no probe needed, entry lands in the table
+        monkeypatch.setenv("TDX_COLLECTIVE_PLANNER", "1")
+        monkeypatch.setenv("TDX_PLANNER_FORCE", "ring")
+        agreed = traced.prepare(world, [("all_reduce", 16 * 4, "sum")])
+        assert list(agreed.values()) == ["ring"]
+        entry = traced.lookup("all_reduce", 16 * 4, "sum")
+        assert entry is not None and entry["alg"] == "ring"
+        assert entry["world"] == world.size()
+
+
+class TestAgreement:
+    """The J005-style sequence-keyed rounds `prepare()` rides."""
+
+    def _agree(self, store, rank, world, seq, eqns, timeout=5.0):
+        return traced.agree_entry(
+            PrefixStore("planagree", store), rank, world, seq,
+            op="all_reduce", bucket=1024, reduce_kind="avg", eqns=eqns,
+            timeout=timeout,
+        )
+
+    def test_skewed_schedules_fail_naming_first_divergent_eqn(self):
+        st = HashStore(30.0)
+        eqns = {
+            0: ["all_reduce.ring|w2|avg|round0|psum_scatter"],
+            1: ["all_reduce.rhd|w2|avg|round0|ppermute[(0,1)]"],
+        }
+        errs = [None, None]
+
+        def worker(r):
+            try:
+                self._agree(st, r, 2, 0, eqns[r])
+            except Exception as e:
+                errs[r] = e
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for r, e in enumerate(errs):
+            assert isinstance(e, ProgramScheduleMismatchError), (r, e)
+            assert "#1" in str(e)  # the first divergent eqn is NAMED
+            assert "ring" in str(e) and "rhd" in str(e)
+
+    def test_late_join_retries_cleanly_under_same_key(self):
+        # rank 0 starts alone, times out, RETRIES at the same seq once
+        # rank 1 joins: idempotent re-publish, both rounds succeed
+        st = HashStore(30.0)
+        eqns = ["all_reduce.ring|w2|avg|round0|psum_scatter"]
+        with pytest.raises(ProgramScheduleMismatchError,
+                           match="never published"):
+            self._agree(st, 0, 2, 0, eqns, timeout=0.3)
+        errs = [None, None]
+
+        def worker(r):
+            try:
+                self._agree(st, r, 2, 0, eqns)
+            except Exception as e:
+                errs[r] = e
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errs == [None, None]
+
+
+_GANG_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+rank = int(os.environ["RANK"])
+jport, sport = (int(a) for a in sys.argv[1:3])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{{jport}}",
+    num_processes=2,
+    process_id=rank,
+)
+
+import pytorch_distributed_example_tpu as tdx
+from pytorch_distributed_example_tpu.plan import traced
+from pytorch_distributed_example_tpu.schedule import (
+    ProgramScheduleMismatchError,
+)
+
+pg = tdx.init_process_group(
+    backend="fake",
+    init_method=f"tcp://127.0.0.1:{{sport}}",
+    rank=rank,
+    world_size=2,
+)
+rc = 0
+try:
+    traced.prepare(pg, [("all_reduce", 256, "avg")], timeout=30.0)
+    print(f"AGREED {{rank}} {{traced.lookup('all_reduce', 256, 'avg')}}")
+except ProgramScheduleMismatchError as e:
+    print(f"MISMATCH {{rank}} {{e}}")
+    rc = 7
+sys.exit(rc)
+"""
+
+
+class TestMultiprocPrepareSkew:
+    """ACCEPTANCE: a skewed `TDX_PLANNER_FORCE` across a real 2-process
+    gang fails `prepare()` — i.e. BEFORE any step compiles, let alone
+    dispatches — on BOTH ranks, naming the first divergent eqn."""
+
+    @pytest.fixture()
+    def _gang(self, tmp_path):
+        def run(force, timeout=120):
+            script = tmp_path / "worker.py"
+            script.write_text(
+                textwrap.dedent(_GANG_WORKER.format(repo=REPO))
+            )
+            jport, sport = free_port(), free_port()
+            procs = []
+            for rank in range(2):
+                env = dict(os.environ)
+                env.update(
+                    {
+                        "RANK": str(rank),
+                        "TDX_COLLECTIVE_PLANNER": "1",
+                        "XLA_FLAGS": (
+                            "--xla_force_host_platform_device_count=2"
+                        ),
+                        "PYTHONPATH": REPO
+                        + os.pathsep
+                        + env.get("PYTHONPATH", ""),
+                    }
+                )
+                if force[rank] is not None:
+                    env["TDX_PLANNER_FORCE"] = force[rank]
+                else:
+                    env.pop("TDX_PLANNER_FORCE", None)
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, str(script), str(jport),
+                         str(sport)],
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT,
+                        env=env,
+                    )
+                )
+            outs = []
+            for p in procs:
+                try:
+                    out, _ = p.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        q.kill()
+                    pytest.fail(f"planner gang hung (force={force})")
+                outs.append(out.decode())
+            return procs, outs
+
+        return run
+
+    def test_skewed_force_fails_prepare_on_both_ranks(self, _gang):
+        procs, outs = _gang(("ring", "rhd"))
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 7, out
+            assert f"MISMATCH {r}" in out
+            assert "#1" in out  # first divergent eqn named
+            assert "AGREED" not in out
+
+    def test_unforced_ranks_adopt_rank0_and_agree(self, _gang):
+        # rank 1 unforced: adopts rank 0's published choice, both agree
+        procs, outs = _gang(("rhd", None))
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, out
+            assert f"AGREED {r}" in out
+            assert "rhd" in out
+
+
+class TestRoutedCallSites:
+    """The TP/ZeRO surfaces route through the seam and stay correct."""
+
+    def test_row_parallel_matmul_planned_matches_stock(self, world):
+        from pytorch_distributed_example_tpu.parallel import (
+            tensor_parallel as tp,
+        )
+
+        W = world.size()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((W, 3, 4)).astype(np.float32)
+        w = rng.standard_normal((W, 4, 5)).astype(np.float32)
+        body = lambda t, wm: tp.row_parallel_matmul(  # noqa: E731
+            t[0], wm[0], AXIS
+        )[None]
+        fn = _sharded(world, body, in_specs=(P(AXIS), P(AXIS)))
+        stock = np.asarray(fn(x, w))
+        traced.seed("all_reduce", "ring", world=W, nbytes=3 * 5 * 4)
+        planned = np.asarray(
+            _sharded(world, body, in_specs=(P(AXIS), P(AXIS)))(x, w)
+        )
+        np.testing.assert_allclose(planned, stock, rtol=1e-5, atol=1e-5)
+
+    def test_zero_unshard_planned_is_bitwise(self, world):
+        from pytorch_distributed_example_tpu.parallel import zero
+
+        W = world.size()
+        full = np.random.default_rng(2).standard_normal(
+            (W * 3, 2)
+        ).astype(np.float32)
+        # unshard takes this rank's (k,) flat shard and regathers the
+        # full leaf
+        shards = full.reshape(W, -1)
+        body = lambda t: zero.unshard(  # noqa: E731
+            t[0], AXIS, full.shape, full.dtype
+        )[None]
+        fn = _sharded(world, body)
+        stock = np.asarray(fn(shards))
+        np.testing.assert_array_equal(stock[0], full)
+        traced.seed(
+            "all_gather", "ring", world=W, nbytes=shards[0].nbytes
+        )
+        ring_fn = _sharded(world, body)
+        assert "ppermute" in str(jax.make_jaxpr(ring_fn)(shards))
+        assert np.asarray(ring_fn(shards)).tobytes() == stock.tobytes()
